@@ -1,0 +1,21 @@
+"""chanamq_tpu — a from-scratch AMQP 0-9-1 message broker framework.
+
+A clean-room rebuild of the capability set of ChanaMQ (reference:
+/root/reference, Scala/Akka): full AMQP 0-9-1 wire codec, broker semantics
+(exchanges, queues, QoS, acks, confirms, TTL), pluggable persistence, and a
+multi-host cluster layer — host-native by design (the reference has no tensor
+compute path; see SURVEY.md §7.1), with compiled C++ hot paths for frame
+parsing and topic routing, and an auxiliary JAX analytics subsystem that sits
+off the message path.
+
+Layer map (mirrors SURVEY.md §1):
+  chanamq_tpu.amqp     — L0 wire codec + protocol model
+  chanamq_tpu.broker   — L2 connection engine + L3 broker entities
+  chanamq_tpu.store    — L5 persistence (memory / sqlite)
+  chanamq_tpu.cluster  — L4 multi-host services (membership, ownership, RPC, ids)
+  chanamq_tpu.rest     — L6 admin API
+  chanamq_tpu.client   — conformance/bench client
+  chanamq_tpu.models/ops/parallel — auxiliary JAX analytics (off the message path)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,133 @@
+"""AMQCommand (method [+ header + body]) rendering and reassembly.
+
+Capability parity with the reference's AMQCommand.render
+(chana-mq-base .../model/AMQCommand.scala:29-65) and CommandAssembler state
+machine (.../engine/CommandAssembler.scala:44-131): a command is one METHOD
+frame, optionally followed by one HEADER frame and zero or more BODY frames;
+rendering fragments the body into <= (frame_max - overhead) chunks; assembly
+is an incremental state machine fed complete frames.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from .constants import FRAME_OVERHEAD, ErrorCode, FrameType
+from .frame import Frame, FrameError
+from .methods import Method, MethodDecodeError, decode_method
+from .properties import BasicProperties
+
+
+@dataclass(slots=True)
+class AMQCommand:
+    """A fully-assembled AMQP command on one channel."""
+
+    channel: int
+    method: Method
+    properties: Optional[BasicProperties] = None
+    body: bytes = b""
+
+    def render_frames(self, frame_max: int) -> list[Frame]:
+        if frame_max and frame_max <= FRAME_OVERHEAD:
+            raise ValueError(f"frame_max {frame_max} leaves no room for payload")
+        frames = [Frame.method(self.channel, self.method.encode())]
+        if self.method.HAS_CONTENT:
+            props = self.properties or BasicProperties()
+            frames.append(Frame.header(self.channel, props.encode_header(len(self.body))))
+            body = self.body
+            max_payload = (frame_max - FRAME_OVERHEAD) if frame_max else max(len(body), 1)
+            for off in range(0, len(body), max_payload):
+                frames.append(Frame.body(self.channel, body[off : off + max_payload]))
+        return frames
+
+    def render(self, frame_max: int) -> bytes:
+        return b"".join(f.to_bytes() for f in self.render_frames(frame_max))
+
+
+class CommandAssembler:
+    """Reassembles frames into commands for one connection (all channels).
+
+    Feed it complete frames; it yields `AMQCommand` or `FrameError`.
+    Heartbeat frames are not handled here — filter them before feeding.
+    """
+
+    __slots__ = ("_partial",)
+
+    def __init__(self) -> None:
+        # channel id -> in-flight (command, expected_body_size, received_size)
+        self._partial: dict[int, _Partial] = {}
+
+    def feed(self, frame: Frame) -> Iterator["AMQCommand | FrameError"]:
+        channel = frame.channel
+        partial = self._partial.get(channel)
+        if frame.type == FrameType.METHOD:
+            if partial is not None:
+                yield FrameError(
+                    ErrorCode.UNEXPECTED_FRAME,
+                    f"method frame while content pending on channel {channel}",
+                )
+                return
+            try:
+                method = decode_method(frame.payload)
+            except MethodDecodeError as exc:
+                yield FrameError(ErrorCode.COMMAND_INVALID, str(exc))
+                return
+            except Exception as exc:
+                yield FrameError(ErrorCode.SYNTAX_ERROR, f"bad method arguments: {exc}")
+                return
+            if method.HAS_CONTENT:
+                self._partial[channel] = _Partial(AMQCommand(channel, method))
+            else:
+                yield AMQCommand(channel, method)
+        elif frame.type == FrameType.HEADER:
+            if partial is None or partial.expected_size is not None:
+                yield FrameError(
+                    ErrorCode.UNEXPECTED_FRAME,
+                    f"unexpected header frame on channel {channel}",
+                )
+                return
+            try:
+                _class_id, body_size, props = BasicProperties.decode_header(frame.payload)
+            except Exception as exc:
+                yield FrameError(ErrorCode.SYNTAX_ERROR, f"bad content header: {exc}")
+                return
+            partial.command.properties = props
+            partial.expected_size = body_size
+            if body_size == 0:
+                del self._partial[channel]
+                yield partial.command
+        elif frame.type == FrameType.BODY:
+            if partial is None or partial.expected_size is None:
+                yield FrameError(
+                    ErrorCode.UNEXPECTED_FRAME,
+                    f"unexpected body frame on channel {channel}",
+                )
+                return
+            partial.chunks.append(frame.payload)
+            partial.received += len(frame.payload)
+            if partial.received > partial.expected_size:
+                del self._partial[channel]
+                yield FrameError(
+                    ErrorCode.FRAME_ERROR,
+                    f"body overflows declared size on channel {channel}",
+                )
+                return
+            if partial.received == partial.expected_size:
+                partial.command.body = b"".join(partial.chunks)
+                del self._partial[channel]
+                yield partial.command
+        else:
+            yield FrameError(ErrorCode.UNEXPECTED_FRAME, f"frame type {frame.type}")
+
+    def abort_channel(self, channel: int) -> None:
+        """Drop any in-flight content on a channel (e.g. on channel close)."""
+        self._partial.pop(channel, None)
+
+
+@dataclass(slots=True)
+class _Partial:
+    command: AMQCommand
+    expected_size: Optional[int] = None
+    received: int = 0
+    chunks: list[bytes] = field(default_factory=list)

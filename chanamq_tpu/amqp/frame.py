@@ -1,0 +1,122 @@
+"""AMQP frame model and incremental frame parser.
+
+Capability parity with the reference's Frame model and streaming parser
+(chana-mq-base .../model/Frame.scala:38-216,
+ .../engine/FrameParser.scala:67-158): a frame is
+type(1) channel(2) size(4) payload(size) end(0xCE); the parser is an
+incremental push parser that accepts arbitrary byte chunks and yields complete
+frames, enforcing the negotiated frame-max and yielding protocol errors
+instead of raising mid-stream.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Iterator
+
+from .constants import (
+    FRAME_END,
+    FRAME_HEADER_SIZE,
+    FrameType,
+    ErrorCode,
+)
+
+_HEADER_STRUCT = struct.Struct(">BHI")
+
+
+@dataclass(frozen=True, slots=True)
+class Frame:
+    type: int
+    channel: int
+    payload: bytes
+
+    def to_bytes(self) -> bytes:
+        return (
+            _HEADER_STRUCT.pack(self.type, self.channel, len(self.payload))
+            + self.payload
+            + b"\xce"
+        )
+
+    @staticmethod
+    def method(channel: int, payload: bytes) -> "Frame":
+        return Frame(FrameType.METHOD, channel, payload)
+
+    @staticmethod
+    def header(channel: int, payload: bytes) -> "Frame":
+        return Frame(FrameType.HEADER, channel, payload)
+
+    @staticmethod
+    def body(channel: int, payload: bytes) -> "Frame":
+        return Frame(FrameType.BODY, channel, payload)
+
+
+HEARTBEAT_FRAME = Frame(FrameType.HEARTBEAT, 0, b"")
+HEARTBEAT_BYTES = HEARTBEAT_FRAME.to_bytes()
+
+
+@dataclass(frozen=True, slots=True)
+class FrameError:
+    """A protocol-level framing error to be reported via Connection.Close."""
+
+    code: ErrorCode
+    message: str
+
+
+class FrameParser:
+    """Incremental frame parser.
+
+    Feed byte chunks with :meth:`feed`; it yields `Frame` or `FrameError`
+    items. After a `FrameError` the parser stops consuming (the connection is
+    expected to close).
+    """
+
+    __slots__ = ("frame_max", "_buf", "_dead")
+
+    def __init__(self, frame_max: int = 0) -> None:
+        # frame_max == 0 means "not yet negotiated": accept any size.
+        self.frame_max = frame_max
+        self._buf = bytearray()
+        self._dead = False
+
+    def feed(self, data: bytes) -> Iterator[Frame | FrameError]:
+        if self._dead:
+            return
+        buf = self._buf
+        buf += data
+        offset = 0
+        n = len(buf)
+        while n - offset >= FRAME_HEADER_SIZE:
+            ftype, channel, size = _HEADER_STRUCT.unpack_from(buf, offset)
+            # Validate the type from the header alone: a corrupt stream would
+            # otherwise make us buffer up to a bogus 4-byte size field.
+            if ftype not in (
+                FrameType.METHOD,
+                FrameType.HEADER,
+                FrameType.BODY,
+                FrameType.HEARTBEAT,
+            ):
+                self._dead = True
+                yield FrameError(ErrorCode.FRAME_ERROR, f"unknown frame type {ftype}")
+                return
+            if self.frame_max and size + 8 > self.frame_max:
+                self._dead = True
+                yield FrameError(
+                    ErrorCode.FRAME_ERROR,
+                    f"frame size {size} exceeds negotiated frame-max {self.frame_max}",
+                )
+                return
+            end = offset + FRAME_HEADER_SIZE + size
+            if n < end + 1:
+                break
+            if buf[end] != FRAME_END:
+                self._dead = True
+                yield FrameError(
+                    ErrorCode.FRAME_ERROR,
+                    f"missing frame-end octet (got 0x{buf[end]:02x})",
+                )
+                return
+            yield Frame(ftype, channel, bytes(buf[offset + FRAME_HEADER_SIZE : end]))
+            offset = end + 1
+        if offset:
+            del buf[:offset]

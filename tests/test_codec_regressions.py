@@ -1,0 +1,41 @@
+"""Regression tests for codec defects found in review/verification."""
+
+import decimal
+
+import pytest
+
+from chanamq_tpu.amqp import value_codec as vc
+from chanamq_tpu.amqp import methods as m
+from chanamq_tpu.amqp.command import AMQCommand
+from chanamq_tpu.amqp.frame import FrameError, FrameParser
+from chanamq_tpu.amqp.properties import BasicProperties
+
+
+def test_decimal_positive_exponent_roundtrip():
+    # 1E+2 must survive as 100, not be scaled down to 1
+    out = vc.decode_table(vc.encode_table({"d": decimal.Decimal("1E+2")}))
+    assert out["d"] == 100
+
+
+def test_non_utf8_longstr_reencodes_verbatim():
+    raw = b"\x00\x00\x00\x09\x01kS\x00\x00\x00\x02\xff\xfe"
+    assert vc.encode_table(vc.decode_table(raw)) == raw
+
+
+def test_methods_with_tables_are_hashable():
+    assert isinstance(hash(m.Queue.Declare(arguments={"x": 1})), int)
+    assert hash(m.Basic.Ack(delivery_tag=1)) != hash(m.Basic.Ack(delivery_tag=2))
+
+
+def test_render_rejects_degenerate_frame_max():
+    cmd = AMQCommand(1, m.Basic.Publish(exchange="e"), BasicProperties(), b"abc")
+    for bad in (1, 7, 8):
+        with pytest.raises(ValueError):
+            cmd.render_frames(bad)
+
+
+def test_parser_rejects_garbage_from_header_alone():
+    # corrupt stream with a huge bogus size field must error immediately,
+    # not buffer gigabytes waiting for it
+    out = list(FrameParser().feed(b"\x41" * 12))
+    assert isinstance(out[0], FrameError)
