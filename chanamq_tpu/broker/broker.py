@@ -1,0 +1,487 @@
+"""The Broker facade: vhosts, entity lifecycle, routing, persistence glue.
+
+Rebuilds the broker-state side of the reference's entity actors and their
+store write-through (ExchangeEntity.scala:198-365, QueueEntity.scala:162-487,
+MessageEntity.scala:114-198, VhostEntity.scala:20-131) as plain single-loop
+state with explicit, strictly-ordered async store writes:
+
+- control mutations (declare/bind/delete) are AWAITED before replying, so a
+  positive reply implies durability — unlike the reference's partial-failure
+  windows (SURVEY.md §7.3 "failover without message loss");
+- hot-path bookkeeping (queue log, watermark, unacks) is fire-and-forget but
+  FIFO via the store's single writer thread (store_bg), preserving order.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Awaitable, Optional
+
+from ..amqp.constants import ErrorCode, ExchangeType
+from ..amqp.properties import BasicProperties
+from ..cluster.idgen import IdGenerator
+from ..store.api import StoredExchange, StoredMessage, StoredQueue, StoreService
+from ..store.memory import MemoryStore
+from ..utils.metrics import Metrics
+from .entities import Exchange, Message, Queue, VHost, now_ms
+
+log = logging.getLogger("chanamq.broker")
+
+DEFAULT_VHOST = "/"
+
+
+class BrokerError(Exception):
+    """Protocol-level error to be reported on the channel or connection."""
+
+    def __init__(self, code: ErrorCode, message: str) -> None:
+        super().__init__(message)
+        self.code = code
+        self.text = message
+
+
+class Broker:
+    """All broker state for one node."""
+
+    def __init__(
+        self,
+        store: Optional[StoreService] = None,
+        node_id: int = 0,
+        message_sweep_interval_s: float = 1.0,
+    ) -> None:
+        self.store = store or MemoryStore()
+        self.idgen = IdGenerator(node_id)
+        self.metrics = Metrics()
+        self.vhosts: dict[str, VHost] = {}
+        self.message_sweep_interval_s = message_sweep_interval_s
+        self._sweep_task: Optional[asyncio.Task] = None
+        self._bg_tasks: set[asyncio.Task] = set()
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        await self.store.open()
+        await self.recover()
+        if DEFAULT_VHOST not in self.vhosts:
+            await self.create_vhost(DEFAULT_VHOST)
+        if self.message_sweep_interval_s > 0:
+            self._sweep_task = asyncio.create_task(self._sweep_loop())
+        self._started = True
+
+    async def stop(self) -> None:
+        if self._sweep_task:
+            self._sweep_task.cancel()
+            self._sweep_task = None
+        # let queued background store writes drain before closing
+        if self._bg_tasks:
+            await asyncio.gather(*self._bg_tasks, return_exceptions=True)
+        await self.store.close()
+        self._started = False
+
+    def store_bg(self, coro: Awaitable[None]) -> None:
+        """Fire-and-forget store write. Ordering: tasks are created in call
+        order and each store op's first await is its executor submit, so the
+        single writer thread executes them FIFO."""
+        task = asyncio.get_event_loop().create_task(coro)  # type: ignore[arg-type]
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_done)
+
+    def _bg_done(self, task: asyncio.Task) -> None:
+        self._bg_tasks.discard(task)
+        if not task.cancelled() and task.exception():
+            log.error("background store write failed: %r", task.exception())
+
+    # -- recovery (reference: stash-until-Loaded preStart reloads,
+    #    QueueEntity.scala:107-135, ExchangeEntity.scala:137-174) ----------
+
+    async def recover(self) -> None:
+        for name, active in await self.store.all_vhosts():
+            vhost = VHost(name)
+            vhost.active = active
+            self.vhosts[name] = vhost
+        for stored_ex in await self.store.all_exchanges():
+            vhost = self.vhosts.get(stored_ex.vhost)
+            if vhost is None:
+                continue
+            exchange = Exchange(
+                stored_ex.vhost, stored_ex.name, stored_ex.type,
+                durable=stored_ex.durable, auto_delete=stored_ex.auto_delete,
+                internal=stored_ex.internal, arguments=stored_ex.arguments,
+            )
+            for routing_key, queue_name, bind_args in stored_ex.binds:
+                exchange.matcher.bind(routing_key, queue_name, bind_args)
+            vhost.exchanges[stored_ex.name] = exchange
+        for sq in await self.store.all_queues():
+            vhost = self.vhosts.get(sq.vhost)
+            if vhost is None:
+                continue
+            queue = Queue(
+                self, sq.vhost, sq.name, durable=sq.durable,
+                auto_delete=sq.auto_delete, ttl_ms=sq.ttl_ms,
+                arguments=sq.arguments,
+            )
+            queue.last_consumed = sq.last_consumed
+            # pending messages + unacked (unacked become redeliverable:
+            # reference re-reads queue_unacks into the pending set on reload)
+            entries = list(sq.msgs) + [
+                (offset, msg_id, size, exp)
+                for msg_id, (offset, size, exp) in sq.unacks.items()
+            ]
+            entries.sort(key=lambda e: e[0])
+            max_offset = sq.last_consumed
+            for offset, msg_id, _size, expire_at in entries:
+                stored_msg = await self.store.select_message(msg_id)
+                if stored_msg is None:
+                    continue
+                message = self._inflate(stored_msg)
+                message.refer_count = stored_msg.refer_count
+                message.persisted = True
+                from .entities import QueuedMessage
+
+                qm = QueuedMessage(message, offset, expire_at)
+                queue.messages.append(qm)
+                max_offset = max(max_offset, offset)
+            queue.next_offset = max_offset + 1
+            if sq.unacks:
+                # Recovered unacks re-enter the queue as ready messages. They
+                # must survive a second crash, so convert the store rows:
+                # re-insert queue_msgs, rewind the persisted watermark, then
+                # drop the unack rows (FIFO store thread preserves order).
+                min_unacked = min(off for (off, _, _) in sq.unacks.values())
+                queue.last_consumed = min(sq.last_consumed, min_unacked - 1)
+                for msg_id, (offset, size, exp) in sq.unacks.items():
+                    self.store_bg(self.store.insert_queue_msg(
+                        sq.vhost, sq.name, offset, msg_id, size, exp))
+                self.store_bg(self.store.update_queue_last_consumed(
+                    sq.vhost, sq.name, queue.last_consumed))
+                self.store_bg(self.store.delete_queue_unacks(
+                    sq.vhost, sq.name, list(sq.unacks)))
+            vhost.queues[sq.name] = queue
+        n_q = sum(len(v.queues) for v in self.vhosts.values())
+        if n_q:
+            log.info("recovered %d vhosts, %d queues", len(self.vhosts), n_q)
+
+    def _inflate(self, stored: StoredMessage) -> Message:
+        _, _, props = BasicProperties.decode_header(stored.properties_raw)
+        return Message(
+            stored.id, props, stored.body, stored.exchange,
+            stored.routing_key, stored.ttl_ms,
+        )
+
+    # -- vhosts ------------------------------------------------------------
+
+    def vhost(self, name: str) -> VHost:
+        vhost = self.vhosts.get(name)
+        if vhost is None or not vhost.active:
+            raise BrokerError(ErrorCode.NOT_FOUND, f"no vhost '{name}'")
+        return vhost
+
+    async def create_vhost(self, name: str) -> VHost:
+        vhost = self.vhosts.get(name)
+        if vhost is None:
+            vhost = VHost(name)
+            self.vhosts[name] = vhost
+            await self.store.insert_vhost(name, True)
+        return vhost
+
+    async def delete_vhost(self, name: str) -> bool:
+        vhost = self.vhosts.pop(name, None)
+        if vhost is None:
+            return False
+        for queue in list(vhost.queues.values()):
+            queue.deleted = True
+        await self.store.delete_vhost(name)
+        return True
+
+    # -- exchanges ---------------------------------------------------------
+
+    async def declare_exchange(
+        self, vhost_name: str, name: str, type: str, *,
+        passive: bool = False, durable: bool = False, auto_delete: bool = False,
+        internal: bool = False, arguments: Optional[dict[str, Any]] = None,
+    ) -> Exchange:
+        vhost = self.vhost(vhost_name)
+        existing = vhost.exchanges.get(name)
+        if passive:
+            if existing is None:
+                raise BrokerError(ErrorCode.NOT_FOUND, f"no exchange '{name}'")
+            return existing
+        if name.startswith("amq."):
+            raise BrokerError(
+                ErrorCode.ACCESS_REFUSED, f"exchange name '{name}' is reserved")
+        try:
+            ex_type = ExchangeType.of(type).value
+        except ValueError:
+            raise BrokerError(
+                ErrorCode.COMMAND_INVALID, f"unknown exchange type '{type}'"
+            ) from None
+        if existing is not None:
+            if not existing.equivalent(ex_type, durable, auto_delete, internal):
+                raise BrokerError(
+                    ErrorCode.PRECONDITION_FAILED,
+                    f"exchange '{name}' redeclared with different settings")
+            return existing
+        exchange = Exchange(
+            vhost_name, name, ex_type, durable=durable,
+            auto_delete=auto_delete, internal=internal, arguments=arguments,
+        )
+        vhost.exchanges[name] = exchange
+        if durable:
+            await self.store.insert_exchange(StoredExchange(
+                vhost=vhost_name, name=name, type=ex_type, durable=durable,
+                auto_delete=auto_delete, internal=internal,
+                arguments=arguments or {},
+            ))
+        return exchange
+
+    async def delete_exchange(
+        self, vhost_name: str, name: str, *, if_unused: bool = False
+    ) -> None:
+        vhost = self.vhost(vhost_name)
+        exchange = vhost.exchanges.get(name)
+        if exchange is None:
+            return  # 0-9-1: deleting a missing exchange is not an error
+        if name == "" or name.startswith("amq."):
+            raise BrokerError(
+                ErrorCode.ACCESS_REFUSED, f"exchange '{name}' is reserved")
+        if if_unused and not exchange.matcher.is_empty():
+            raise BrokerError(ErrorCode.PRECONDITION_FAILED, f"exchange '{name}' in use")
+        del vhost.exchanges[name]
+        if exchange.durable:
+            await self.store.delete_exchange(vhost_name, name)
+
+    # -- queues ------------------------------------------------------------
+
+    async def declare_queue(
+        self, vhost_name: str, name: str, *,
+        passive: bool = False, durable: bool = False, exclusive_owner: Optional[int] = None,
+        auto_delete: bool = False, arguments: Optional[dict[str, Any]] = None,
+        connection_id: Optional[int] = None,
+    ) -> Queue:
+        vhost = self.vhost(vhost_name)
+        existing = vhost.queues.get(name)
+        if passive:
+            if existing is None:
+                raise BrokerError(ErrorCode.NOT_FOUND, f"no queue '{name}'")
+            self._check_exclusive(existing, connection_id)
+            return existing
+        if name.startswith("amq."):
+            raise BrokerError(
+                ErrorCode.ACCESS_REFUSED, f"queue name '{name}' is reserved")
+        if existing is not None:
+            self._check_exclusive(existing, connection_id)
+            return existing
+        arguments = arguments or {}
+        ttl_ms = arguments.get("x-message-ttl")
+        if ttl_ms is not None and (not isinstance(ttl_ms, int) or ttl_ms < 0):
+            raise BrokerError(
+                ErrorCode.PRECONDITION_FAILED, "invalid x-message-ttl")
+        queue = Queue(
+            self, vhost_name, name, durable=durable,
+            exclusive_owner=exclusive_owner, auto_delete=auto_delete,
+            ttl_ms=ttl_ms, arguments=arguments,
+        )
+        vhost.queues[name] = queue
+        if durable and not exclusive_owner:
+            await self.store.insert_queue_meta(StoredQueue(
+                vhost=vhost_name, name=name, durable=durable,
+                exclusive=False, auto_delete=auto_delete, ttl_ms=ttl_ms,
+                last_consumed=0, arguments=arguments,
+            ))
+        return queue
+
+    def _check_exclusive(self, queue: Queue, connection_id: Optional[int]) -> None:
+        if queue.exclusive_owner is not None and queue.exclusive_owner != connection_id:
+            raise BrokerError(
+                ErrorCode.RESOURCE_LOCKED,
+                f"queue '{queue.name}' is exclusive to another connection")
+
+    def get_queue(
+        self, vhost_name: str, name: str, connection_id: Optional[int] = None
+    ) -> Queue:
+        vhost = self.vhost(vhost_name)
+        queue = vhost.queues.get(name)
+        if queue is None:
+            raise BrokerError(ErrorCode.NOT_FOUND, f"no queue '{name}'")
+        self._check_exclusive(queue, connection_id)
+        return queue
+
+    async def bind_queue(
+        self, vhost_name: str, queue_name: str, exchange_name: str,
+        routing_key: str, arguments: Optional[dict] = None,
+        connection_id: Optional[int] = None,
+    ) -> None:
+        vhost = self.vhost(vhost_name)
+        queue = self.get_queue(vhost_name, queue_name, connection_id)
+        exchange = vhost.exchanges.get(exchange_name)
+        if exchange is None:
+            raise BrokerError(ErrorCode.NOT_FOUND, f"no exchange '{exchange_name}'")
+        if exchange_name == "":
+            raise BrokerError(
+                ErrorCode.ACCESS_REFUSED, "cannot bind to the default exchange")
+        added = exchange.matcher.bind(routing_key, queue_name, arguments)
+        if added and exchange.durable and queue.durable:
+            await self.store.insert_bind(
+                vhost_name, exchange_name, queue_name, routing_key, arguments)
+
+    async def unbind_queue(
+        self, vhost_name: str, queue_name: str, exchange_name: str,
+        routing_key: str, arguments: Optional[dict] = None,
+        connection_id: Optional[int] = None,
+    ) -> None:
+        vhost = self.vhost(vhost_name)
+        self.get_queue(vhost_name, queue_name, connection_id)
+        exchange = vhost.exchanges.get(exchange_name)
+        if exchange is None:
+            raise BrokerError(ErrorCode.NOT_FOUND, f"no exchange '{exchange_name}'")
+        removed = exchange.matcher.unbind(routing_key, queue_name, arguments)
+        if removed and exchange.durable:
+            await self.store.delete_bind(
+                vhost_name, exchange_name, queue_name, routing_key)
+        if removed and exchange.auto_delete and exchange.matcher.is_empty():
+            await self.delete_exchange(vhost_name, exchange_name)
+
+    async def delete_queue(
+        self, vhost_name: str, name: str, *,
+        if_unused: bool = False, if_empty: bool = False,
+        connection_id: Optional[int] = None,
+    ) -> int:
+        vhost = self.vhost(vhost_name)
+        queue = vhost.queues.get(name)
+        if queue is None:
+            return 0
+        self._check_exclusive(queue, connection_id)
+        if if_unused and queue.consumer_count > 0:
+            raise BrokerError(ErrorCode.PRECONDITION_FAILED, f"queue '{name}' in use")
+        if if_empty and queue.message_count > 0:
+            raise BrokerError(ErrorCode.PRECONDITION_FAILED, f"queue '{name}' not empty")
+        return await self._remove_queue(vhost, queue)
+
+    async def _remove_queue(self, vhost: VHost, queue: Queue) -> int:
+        queue.deleted = True
+        del vhost.queues[queue.name]
+        count = len(queue.messages)
+        # unbind everywhere (reference broadcasts QueueDeleted on pub-sub)
+        for exchange in list(vhost.exchanges.values()):
+            if exchange.matcher.unbind_queue(queue.name) and exchange.auto_delete \
+                    and exchange.matcher.is_empty() and exchange.name:
+                vhost.exchanges.pop(exchange.name, None)
+                if exchange.durable:
+                    await self.store.delete_exchange(vhost.name, exchange.name)
+        for consumer in list(queue.consumers):
+            consumer.channel.consumers.pop(consumer.tag, None)
+            queue.consumers.remove(consumer)
+        for qm in queue.messages:
+            self.unrefer(qm.message)
+        queue.messages.clear()
+        if queue.durable:
+            await self.store.archive_queue(vhost.name, queue.name)
+            await self.store.delete_queue(vhost.name, queue.name)
+            await self.store.delete_queue_binds(vhost.name, queue.name)
+        if self._cluster_publish is not None:
+            self._cluster_publish("queue.deleted", vhost.name, queue.name)
+        return count
+
+    _cluster_publish = None  # hook for the cluster pub-sub layer
+
+    def schedule_queue_delete(self, vhost_name: str, queue_name: str) -> None:
+        """Auto-delete path from sync contexts (consumer cancel)."""
+
+        async def _delete() -> None:
+            try:
+                vhost = self.vhosts.get(vhost_name)
+                if vhost and queue_name in vhost.queues:
+                    await self._remove_queue(vhost, vhost.queues[queue_name])
+            except Exception:
+                log.exception("auto-delete of queue %s failed", queue_name)
+
+        asyncio.get_event_loop().create_task(_delete())
+
+    # -- publish path (reference: FrameStage.scala:462-607 +
+    #    ExchangeEntity.publish ExchangeEntity.scala:287-331) --------------
+
+    async def publish(
+        self,
+        vhost_name: str,
+        exchange_name: str,
+        routing_key: str,
+        properties: BasicProperties,
+        body: bytes,
+        *,
+        mandatory: bool = False,
+        immediate: bool = False,
+    ) -> tuple[bool, bool]:
+        """Route one message. Returns (routed, deliverable):
+        routed=False    -> mandatory handling applies,
+        deliverable=False (with immediate) -> immediate handling applies.
+        Durability: awaited store writes happen before return, so a confirm
+        sent after this implies persistence."""
+        vhost = self.vhost(vhost_name)
+        exchange = vhost.exchanges.get(exchange_name)
+        if exchange is None:
+            raise BrokerError(ErrorCode.NOT_FOUND, f"no exchange '{exchange_name}'")
+        if exchange.internal:
+            raise BrokerError(
+                ErrorCode.ACCESS_REFUSED, f"exchange '{exchange_name}' is internal")
+        queue_names = vhost.route(exchange_name, routing_key, properties.headers)
+        assert queue_names is not None
+        queues = [vhost.queues[qn] for qn in queue_names if qn in vhost.queues]
+        self.metrics.published(len(body))
+        if not queues:
+            return (False, True)
+        message = Message(
+            self.idgen.next_id(), properties, body, exchange_name, routing_key,
+            properties.expiration_ms(),
+        )
+        message.refer_count = len(queues)
+        # persistence decision (reference: ExchangeEntity.scala:302):
+        # message persistent AND at least one routed queue durable
+        persist = message.is_persistent and any(q.durable for q in queues)
+        if persist:
+            message.persisted = True
+            await self.store.insert_message(StoredMessage(
+                id=message.id,
+                properties_raw=properties.encode_header(len(body)),
+                body=body, exchange=exchange_name, routing_key=routing_key,
+                refer_count=len(queues), ttl_ms=message.ttl_ms,
+            ))
+        deliverable = True
+        if immediate:
+            deliverable = any(
+                any(c.can_take(len(body)) for c in q.consumers) for q in queues
+            )
+            if not deliverable:
+                self.unrefer_n(message, len(queues))
+                return (True, False)
+        for queue in queues:
+            queue.push(message)
+        return (True, True)
+
+    # -- message refcounting (reference: MessageEntity.scala:134-166) ------
+
+    def unrefer(self, message: Message) -> None:
+        self.unrefer_n(message, 1)
+
+    def unrefer_n(self, message: Message, n: int) -> None:
+        message.refer_count -= n
+        if message.refer_count <= 0 and message.persisted:
+            message.persisted = False
+            self.store_bg(self.store.delete_message(message.id))
+
+    # -- TTL sweep ---------------------------------------------------------
+
+    async def _sweep_loop(self) -> None:
+        """Periodic head-expiry pass so TTL'd messages don't linger in
+        consumerless queues (the reference used per-entity timers,
+        MessageEntity.scala:168-198)."""
+        try:
+            while True:
+                await asyncio.sleep(self.message_sweep_interval_s)
+                for vhost in self.vhosts.values():
+                    for queue in vhost.queues.values():
+                        before = len(queue.messages)
+                        queue._expire_head()
+                        self.metrics.expired_msgs += before - len(queue.messages)
+        except asyncio.CancelledError:
+            pass

@@ -1,0 +1,754 @@
+"""Per-connection AMQP protocol engine.
+
+Capability parity with the reference's FrameStage GraphStage
+(chana-mq-server .../engine/FrameStage.scala:53-1297): protocol-header
+handshake, SASL (PLAIN/EXTERNAL), tune negotiation, vhost open, channel
+lifecycle, the full method dispatch (exchange/queue/basic/confirm/tx/access),
+publish routing with mandatory/immediate returns, confirm-mode acks with
+multiple-coalescing, QoS, ack/nack/reject/recover, heartbeats, and teardown
+of exclusive queues on connection death.
+
+Engine shape, by design (SURVEY.md §7.3 "pipelined command batching"): one
+reader task processes commands strictly in order per connection; one writer
+task drains an explicit output buffer (the reference's subtle isLastCommand
+batching becomes trivially correct — everything appended between drains
+coalesces into one TCP write). Delivery pushes come from queue dispatch
+(event-driven), never from a poll tick.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from typing import Optional
+
+from ..amqp.command import AMQCommand, CommandAssembler
+from ..amqp.constants import (
+    ClassId,
+    ErrorCode,
+    FRAME_MIN_SIZE,
+    FrameType,
+    PROTOCOL_HEADER,
+)
+from ..amqp.frame import Frame, FrameError, FrameParser, HEARTBEAT_BYTES
+from ..amqp import methods as am
+from ..amqp.properties import BasicProperties
+from .broker import Broker, BrokerError
+from .channel import ChannelMode, Consumer, ServerChannel
+from .entities import now_ms
+
+log = logging.getLogger("chanamq.connection")
+
+SERVER_PROPERTIES = {
+    "product": "chanamq-tpu",
+    "version": "0.1.0",
+    "platform": "Python/asyncio",
+    "capabilities": {
+        "publisher_confirms": True,
+        "basic.nack": True,
+        "consumer_cancel_notify": False,
+        "exchange_exchange_bindings": False,
+    },
+}
+
+MECHANISMS = b"PLAIN EXTERNAL"
+LOCALES = b"en_US"
+
+# output buffer watermarks: above high, queue dispatch skips this connection's
+# consumers; below low, dispatch resumes (SURVEY.md §7.3 "backpressure")
+WRITE_HIGH_WATERMARK = 4 * 1024 * 1024
+WRITE_LOW_WATERMARK = 1 * 1024 * 1024
+
+
+class ConnectionClosed(Exception):
+    pass
+
+
+class ChannelError(Exception):
+    def __init__(self, code: ErrorCode, text: str, class_id: int = 0, method_id: int = 0):
+        super().__init__(text)
+        self.code = code
+        self.text = text
+        self.class_id = class_id
+        self.method_id = method_id
+
+
+class HardError(ChannelError):
+    """Connection-level error: close the whole connection."""
+
+
+class AMQPConnection:
+    """One client connection being served."""
+
+    _next_id = 1
+
+    def __init__(
+        self,
+        broker: Broker,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        *,
+        heartbeat_s: int = 30,
+        frame_max: int = 131072,
+        channel_max: int = 2047,
+    ) -> None:
+        self.broker = broker
+        self.reader = reader
+        self.writer = writer
+        self.id = AMQPConnection._next_id
+        AMQPConnection._next_id += 1
+
+        self.cfg_heartbeat = heartbeat_s
+        self.cfg_frame_max = frame_max
+        self.cfg_channel_max = channel_max
+        self.heartbeat_s = 0
+        self.frame_max = frame_max
+        self.channel_max = channel_max
+
+        self.vhost_name: str = ""
+        self.channels: dict[int, ServerChannel] = {}
+        # channels we soft-closed: frames on them are discarded until the
+        # client's Channel.CloseOk arrives (0-9-1 close protocol)
+        self._closing_channels: set[int] = set()
+        self.exclusive_queues: set[str] = set()
+        self.closing = False
+        self.closed = asyncio.get_event_loop().create_future()
+
+        self._parser = FrameParser()
+        self._assembler = CommandAssembler()
+        self._out = bytearray()
+        self._out_event = asyncio.Event()
+        self._writer_task: Optional[asyncio.Task] = None
+        self._heartbeat_task: Optional[asyncio.Task] = None
+        self._last_recv = time.monotonic()
+        self._last_send = time.monotonic()
+        # publish-timestamp ring for latency measurement (confirm-less)
+        self._authenticated = False
+        self._tuned = False
+        self._opened = False
+
+    # ------------------------------------------------------------------
+    # output path
+    # ------------------------------------------------------------------
+
+    @property
+    def write_saturated(self) -> bool:
+        return len(self._out) >= WRITE_HIGH_WATERMARK
+
+    def send_bytes(self, data: bytes) -> None:
+        if self.closing:
+            return
+        self._out += data
+        self._out_event.set()
+
+    def send_command(self, command: AMQCommand) -> None:
+        self.send_bytes(command.render(self.frame_max))
+
+    def send_method(self, channel: int, method: am.Method) -> None:
+        self.send_bytes(Frame.method(channel, method.encode()).to_bytes())
+
+    async def _writer_loop(self) -> None:
+        try:
+            while True:
+                await self._out_event.wait()
+                self._out_event.clear()
+                if self._out:
+                    data = bytes(self._out)
+                    self._out.clear()
+                    was_saturated = len(data) >= WRITE_HIGH_WATERMARK
+                    self.writer.write(data)
+                    self._last_send = time.monotonic()
+                    await self.writer.drain()
+                    if was_saturated and len(self._out) < WRITE_LOW_WATERMARK:
+                        self._resume_dispatch()
+                if self.closing and not self._out:
+                    break
+        except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
+            pass
+
+    def _resume_dispatch(self) -> None:
+        for channel in self.channels.values():
+            for consumer in channel.consumers.values():
+                consumer.queue.schedule_dispatch()
+
+    # ------------------------------------------------------------------
+    # main loop
+    # ------------------------------------------------------------------
+
+    async def serve(self) -> None:
+        """Run the connection to completion."""
+        self.broker.metrics.connections_opened += 1
+        self._writer_task = asyncio.create_task(self._writer_loop())
+        try:
+            await self._handshake()
+            await self._main_loop()
+        except ConnectionClosed:
+            pass
+        except (ConnectionResetError, BrokenPipeError, asyncio.IncompleteReadError):
+            pass
+        except Exception:
+            log.exception("connection %d crashed", self.id)
+        finally:
+            await self._teardown()
+
+    async def _read_chunk(self) -> bytes:
+        data = await self.reader.read(65536)
+        if not data:
+            raise ConnectionClosed()
+        self._last_recv = time.monotonic()
+        return data
+
+    async def _handshake(self) -> None:
+        """Protocol header exchange (reference: FrameStage.scala:181-234)."""
+        header = await self.reader.readexactly(8)
+        self._last_recv = time.monotonic()
+        if header != PROTOCOL_HEADER:
+            # wrong protocol: reply with ours and hang up
+            self.writer.write(PROTOCOL_HEADER)
+            await self.writer.drain()
+            raise ConnectionClosed()
+        self.send_method(0, am.Connection.Start(
+            version_major=0, version_minor=9,
+            server_properties=SERVER_PROPERTIES,
+            mechanisms=MECHANISMS, locales=LOCALES,
+        ))
+
+    async def _main_loop(self) -> None:
+        while not self.closing:
+            data = await self._read_chunk()
+            for item in self._parser.feed(data):
+                if isinstance(item, FrameError):
+                    await self._hard_close(item.code, item.message)
+                    return
+                if item.type == FrameType.HEARTBEAT:
+                    continue  # _last_recv already updated
+                for out in self._assembler.feed(item):
+                    if isinstance(out, FrameError):
+                        await self._hard_close(out.code, out.message)
+                        return
+                    try:
+                        await self._dispatch(out)
+                    except HardError as exc:
+                        await self._hard_close(
+                            exc.code, exc.text, exc.class_id, exc.method_id)
+                        return
+                    except ChannelError as exc:
+                        self._soft_close_channel(out.channel, exc)
+                    except BrokerError as exc:
+                        if exc.code.is_hard_error:
+                            await self._hard_close(
+                                exc.code, exc.text,
+                                out.method.CLASS_ID, out.method.METHOD_ID)
+                            return
+                        self._soft_close_channel(
+                            out.channel,
+                            ChannelError(exc.code, exc.text,
+                                         out.method.CLASS_ID, out.method.METHOD_ID))
+                    if self.closing:
+                        return
+
+    # ------------------------------------------------------------------
+    # teardown / close
+    # ------------------------------------------------------------------
+
+    async def _hard_close(
+        self, code: ErrorCode, text: str, class_id: int = 0, method_id: int = 0
+    ) -> None:
+        if not self.closing:
+            self.send_method(0, am.Connection.Close(
+                reply_code=int(code), reply_text=text[:255],
+                class_id=class_id, method_id=method_id,
+            ))
+        self.closing = True
+
+    def _soft_close_channel(self, channel_id: int, exc: ChannelError) -> None:
+        """Channel exception: close just the channel (reference behavior for
+        404/405/406 soft errors)."""
+        channel = self.channels.pop(channel_id, None)
+        if channel is not None:
+            channel.release_all()
+        self._assembler.abort_channel(channel_id)
+        self._closing_channels.add(channel_id)
+        self.send_method(channel_id, am.Channel.Close(
+            reply_code=int(exc.code), reply_text=exc.text[:255],
+            class_id=exc.class_id, method_id=exc.method_id,
+        ))
+
+    async def _teardown(self) -> None:
+        self.closing = True
+        # requeue unacked, detach consumers
+        for channel in list(self.channels.values()):
+            channel.release_all()
+        self.channels.clear()
+        # exclusive queues die with the connection (reference:
+        # FrameStage.scala:144-153)
+        for queue_name in list(self.exclusive_queues):
+            try:
+                vhost = self.broker.vhosts.get(self.vhost_name)
+                if vhost and queue_name in vhost.queues:
+                    await self.broker._remove_queue(vhost, vhost.queues[queue_name])
+            except Exception:
+                log.exception("failed deleting exclusive queue %s", queue_name)
+        self.exclusive_queues.clear()
+        if self._heartbeat_task:
+            self._heartbeat_task.cancel()
+        if self._writer_task:
+            self._out_event.set()
+            try:
+                await asyncio.wait_for(self._writer_task, timeout=2)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self._writer_task.cancel()
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except Exception:
+            pass
+        self.broker.metrics.connections_closed += 1
+        if not self.closed.done():
+            self.closed.set_result(None)
+
+    # ------------------------------------------------------------------
+    # heartbeats (reference: FrameStage.scala:100-107,845-851)
+    # ------------------------------------------------------------------
+
+    async def _heartbeat_loop(self) -> None:
+        interval = self.heartbeat_s
+        try:
+            while not self.closing:
+                await asyncio.sleep(interval / 2)
+                now = time.monotonic()
+                if now - self._last_send >= interval / 2:
+                    self.send_bytes(HEARTBEAT_BYTES)
+                if now - self._last_recv > 2 * interval:
+                    log.warning("connection %d heartbeat timeout", self.id)
+                    self.closing = True
+                    self.writer.close()
+                    return
+        except asyncio.CancelledError:
+            pass
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+
+    async def _dispatch(self, command: AMQCommand) -> None:
+        method = command.method
+        if command.channel in self._closing_channels:
+            # discard everything pipelined behind our Channel.Close until the
+            # client acknowledges it
+            if isinstance(method, (am.Channel.CloseOk, am.Channel.Close)):
+                self._closing_channels.discard(command.channel)
+                if isinstance(method, am.Channel.Close):
+                    self.send_method(command.channel, am.Channel.CloseOk())
+            return
+        cid = method.CLASS_ID
+        if not self._opened and cid != ClassId.CONNECTION:
+            raise HardError(
+                ErrorCode.COMMAND_INVALID, "connection not open",
+                cid, method.METHOD_ID)
+        if cid == ClassId.CONNECTION:
+            await self._on_connection(command)
+        elif cid == ClassId.CHANNEL:
+            await self._on_channel(command)
+        elif cid == ClassId.EXCHANGE:
+            await self._on_exchange(command)
+        elif cid == ClassId.QUEUE:
+            await self._on_queue(command)
+        elif cid == ClassId.BASIC:
+            await self._on_basic(command)
+        elif cid == ClassId.CONFIRM:
+            self._on_confirm(command)
+        elif cid == ClassId.TX:
+            self._on_tx(command)
+        elif cid == ClassId.ACCESS:
+            self.send_method(command.channel, am.Access.RequestOk(ticket=0))
+        else:
+            raise HardError(
+                ErrorCode.COMMAND_INVALID, f"unsupported class {cid}",
+                cid, method.METHOD_ID)
+
+    def _channel(self, command: AMQCommand) -> ServerChannel:
+        channel = self.channels.get(command.channel)
+        if channel is None:
+            raise HardError(
+                ErrorCode.CHANNEL_ERROR, f"channel {command.channel} not open",
+                command.method.CLASS_ID, command.method.METHOD_ID)
+        return channel
+
+    # -- connection class --------------------------------------------------
+
+    async def _on_connection(self, command: AMQCommand) -> None:
+        method = command.method
+        if isinstance(method, am.Connection.StartOk):
+            ok = self._authenticate(method.mechanism, bytes(method.response))
+            if not ok:
+                raise HardError(ErrorCode.ACCESS_REFUSED, "authentication failed")
+            self._authenticated = True
+            self.send_method(0, am.Connection.Tune(
+                channel_max=self.cfg_channel_max,
+                frame_max=self.cfg_frame_max,
+                heartbeat=self.cfg_heartbeat,
+            ))
+        elif isinstance(method, am.Connection.SecureOk):
+            raise HardError(ErrorCode.NOT_IMPLEMENTED, "secure-ok unexpected")
+        elif isinstance(method, am.Connection.TuneOk):
+            self.channel_max = min(method.channel_max or self.cfg_channel_max,
+                                   self.cfg_channel_max)
+            client_fm = method.frame_max or self.cfg_frame_max
+            self.frame_max = max(FRAME_MIN_SIZE, min(client_fm, self.cfg_frame_max))
+            self._parser.frame_max = self.frame_max
+            # heartbeat 0 on either side disables heartbeats entirely (a
+            # client sending tune-ok heartbeat=0 must not be timed out)
+            if method.heartbeat == 0 or self.cfg_heartbeat == 0:
+                self.heartbeat_s = 0
+            else:
+                self.heartbeat_s = min(method.heartbeat, self.cfg_heartbeat)
+            self._tuned = True
+            if self.heartbeat_s > 0:
+                self._heartbeat_task = asyncio.create_task(self._heartbeat_loop())
+        elif isinstance(method, am.Connection.Open):
+            if not self._tuned:
+                raise HardError(ErrorCode.COMMAND_INVALID, "tune-ok required first")
+            vhost_name = method.virtual_host or "/"
+            vhost = self.broker.vhosts.get(vhost_name)
+            if vhost is None or not vhost.active:
+                raise HardError(
+                    ErrorCode.INVALID_PATH, f"no vhost '{vhost_name}'",
+                    method.CLASS_ID, method.METHOD_ID)
+            self.vhost_name = vhost_name
+            self._opened = True
+            self.send_method(0, am.Connection.OpenOk())
+        elif isinstance(method, am.Connection.Close):
+            self.send_method(0, am.Connection.CloseOk())
+            self.closing = True
+        elif isinstance(method, am.Connection.CloseOk):
+            self.closing = True
+        elif isinstance(method, (am.Connection.Blocked, am.Connection.Unblocked)):
+            pass  # client-to-server blocked notifications: informational
+        else:
+            raise HardError(
+                ErrorCode.COMMAND_INVALID, f"unexpected {method.NAME}",
+                method.CLASS_ID, method.METHOD_ID)
+
+    def _authenticate(self, mechanism: str, response: bytes) -> bool:
+        """SASL (reference: SaslMechanism.scala:6-98 — PLAIN parses
+        user/password but verifies nothing; auth is unimplemented there too,
+        README 'Status'). A pluggable authenticator can tighten this."""
+        if mechanism == "PLAIN":
+            parts = response.split(b"\x00")
+            return len(parts) == 3
+        if mechanism == "EXTERNAL":
+            return True
+        return False
+
+    # -- channel class -----------------------------------------------------
+
+    async def _on_channel(self, command: AMQCommand) -> None:
+        method = command.method
+        cid = command.channel
+        if isinstance(method, am.Channel.Open):
+            if cid == 0 or cid > self.channel_max:
+                raise HardError(
+                    ErrorCode.CHANNEL_ERROR, f"bad channel id {cid}",
+                    method.CLASS_ID, method.METHOD_ID)
+            if cid in self.channels:
+                raise HardError(
+                    ErrorCode.CHANNEL_ERROR, f"channel {cid} already open",
+                    method.CLASS_ID, method.METHOD_ID)
+            self.channels[cid] = ServerChannel(self, cid)
+            self.send_method(cid, am.Channel.OpenOk())
+        elif isinstance(method, am.Channel.Flow):
+            channel = self._channel(command)
+            channel.flow_active = method.active
+            self.send_method(cid, am.Channel.FlowOk(active=method.active))
+            if method.active:
+                for consumer in channel.consumers.values():
+                    consumer.queue.schedule_dispatch()
+        elif isinstance(method, am.Channel.FlowOk):
+            pass
+        elif isinstance(method, am.Channel.Close):
+            channel = self.channels.pop(cid, None)
+            if channel is not None:
+                channel.release_all()
+            self._assembler.abort_channel(cid)
+            self.send_method(cid, am.Channel.CloseOk())
+        elif isinstance(method, am.Channel.CloseOk):
+            pass
+        else:
+            raise HardError(
+                ErrorCode.COMMAND_INVALID, f"unexpected {method.NAME}",
+                method.CLASS_ID, method.METHOD_ID)
+
+    # -- exchange class (reference: FrameStage.scala:967-1029) -------------
+
+    async def _on_exchange(self, command: AMQCommand) -> None:
+        method = command.method
+        cid = command.channel
+        self._channel(command)
+        if isinstance(method, am.Exchange.Declare):
+            self.broker_check_name(method.exchange, method)
+            await self.broker.declare_exchange(
+                self.vhost_name, method.exchange, method.type,
+                passive=method.passive, durable=method.durable,
+                auto_delete=method.auto_delete, internal=method.internal,
+                arguments=method.arguments,
+            )
+            if not method.nowait:
+                self.send_method(cid, am.Exchange.DeclareOk())
+        elif isinstance(method, am.Exchange.Delete):
+            await self.broker.delete_exchange(
+                self.vhost_name, method.exchange, if_unused=method.if_unused)
+            if not method.nowait:
+                self.send_method(cid, am.Exchange.DeleteOk())
+        elif isinstance(method, (am.Exchange.Bind, am.Exchange.Unbind)):
+            # exchange-to-exchange bindings: the reference stubs these with a
+            # TODO log (FrameStage.scala:1023-1027); we reject them cleanly.
+            raise ChannelError(
+                ErrorCode.NOT_IMPLEMENTED, "exchange-to-exchange bindings",
+                method.CLASS_ID, method.METHOD_ID)
+        else:
+            raise HardError(
+                ErrorCode.COMMAND_INVALID, f"unexpected {method.NAME}",
+                method.CLASS_ID, method.METHOD_ID)
+
+    def broker_check_name(self, name: str, method: am.Method) -> None:
+        if len(name) > 255:
+            raise ChannelError(
+                ErrorCode.PRECONDITION_FAILED, "name too long",
+                method.CLASS_ID, method.METHOD_ID)
+
+    # -- queue class (reference: FrameStage.scala:1031-1149) ---------------
+
+    async def _on_queue(self, command: AMQCommand) -> None:
+        method = command.method
+        cid = command.channel
+        self._channel(command)
+        if isinstance(method, am.Queue.Declare):
+            name = method.queue
+            if not name:
+                name = f"tmp.{uuid.uuid4()}"
+            self.broker_check_name(name, method)
+            queue = await self.broker.declare_queue(
+                self.vhost_name, name,
+                passive=method.passive, durable=method.durable,
+                exclusive_owner=self.id if method.exclusive else None,
+                auto_delete=method.auto_delete, arguments=method.arguments,
+                connection_id=self.id,
+            )
+            if method.exclusive:
+                self.exclusive_queues.add(name)
+            if not method.nowait:
+                self.send_method(cid, am.Queue.DeclareOk(
+                    queue=name,
+                    message_count=queue.message_count,
+                    consumer_count=queue.consumer_count,
+                ))
+        elif isinstance(method, am.Queue.Bind):
+            await self.broker.bind_queue(
+                self.vhost_name, method.queue, method.exchange,
+                method.routing_key, method.arguments, connection_id=self.id)
+            if not method.nowait:
+                self.send_method(cid, am.Queue.BindOk())
+        elif isinstance(method, am.Queue.Unbind):
+            await self.broker.unbind_queue(
+                self.vhost_name, method.queue, method.exchange,
+                method.routing_key, method.arguments, connection_id=self.id)
+            self.send_method(cid, am.Queue.UnbindOk())
+        elif isinstance(method, am.Queue.Purge):
+            queue = self.broker.get_queue(self.vhost_name, method.queue, self.id)
+            count = queue.purge()
+            if not method.nowait:
+                self.send_method(cid, am.Queue.PurgeOk(message_count=count))
+        elif isinstance(method, am.Queue.Delete):
+            count = await self.broker.delete_queue(
+                self.vhost_name, method.queue,
+                if_unused=method.if_unused, if_empty=method.if_empty,
+                connection_id=self.id)
+            self.exclusive_queues.discard(method.queue)
+            if not method.nowait:
+                self.send_method(cid, am.Queue.DeleteOk(message_count=count))
+        else:
+            raise HardError(
+                ErrorCode.COMMAND_INVALID, f"unexpected {method.NAME}",
+                method.CLASS_ID, method.METHOD_ID)
+
+    # -- basic class -------------------------------------------------------
+
+    async def _on_basic(self, command: AMQCommand) -> None:
+        method = command.method
+        cid = command.channel
+        channel = self._channel(command)
+        if isinstance(method, am.Basic.Publish):
+            await self._on_publish(channel, command)
+        elif isinstance(method, am.Basic.Qos):
+            channel.set_qos(method.prefetch_size, method.prefetch_count, method.global_)
+            self.send_method(cid, am.Basic.QosOk())
+        elif isinstance(method, am.Basic.Consume):
+            await self._on_consume(channel, method)
+        elif isinstance(method, am.Basic.Cancel):
+            consumer = channel.consumers.pop(method.consumer_tag, None)
+            if consumer is not None:
+                auto_deleted = consumer.queue.remove_consumer(consumer)
+                if auto_deleted:
+                    self.broker.schedule_queue_delete(
+                        self.vhost_name, consumer.queue.name)
+            if not method.nowait:
+                self.send_method(cid, am.Basic.CancelOk(
+                    consumer_tag=method.consumer_tag))
+        elif isinstance(method, am.Basic.Get):
+            self._on_get(channel, method)
+        elif isinstance(method, am.Basic.Ack):
+            deliveries = channel.resolve_tags(method.delivery_tag, method.multiple)
+            if not deliveries and not method.multiple:
+                raise ChannelError(
+                    ErrorCode.PRECONDITION_FAILED,
+                    f"unknown delivery tag {method.delivery_tag}",
+                    method.CLASS_ID, method.METHOD_ID)
+            for delivery in deliveries:
+                channel.ack(delivery)
+        elif isinstance(method, am.Basic.Nack):
+            deliveries = channel.resolve_tags(method.delivery_tag, method.multiple)
+            for delivery in deliveries:
+                if method.requeue:
+                    channel.requeue(delivery)
+                else:
+                    channel.drop(delivery)
+        elif isinstance(method, am.Basic.Reject):
+            deliveries = channel.resolve_tags(method.delivery_tag, False)
+            for delivery in deliveries:
+                if method.requeue:
+                    channel.requeue(delivery)
+                else:
+                    channel.drop(delivery)
+        elif isinstance(method, (am.Basic.Recover, am.Basic.RecoverAsync)):
+            self._on_recover(channel, method.requeue)
+            if isinstance(method, am.Basic.Recover):
+                self.send_method(cid, am.Basic.RecoverOk())
+        else:
+            raise HardError(
+                ErrorCode.COMMAND_INVALID, f"unexpected {method.NAME}",
+                method.CLASS_ID, method.METHOD_ID)
+
+    async def _on_publish(self, channel: ServerChannel, command: AMQCommand) -> None:
+        method = command.method
+        props = command.properties or BasicProperties()
+        seq = None
+        if channel.mode == ChannelMode.CONFIRM:
+            channel.publish_seq += 1
+            seq = channel.publish_seq
+        routed, deliverable = await self.broker.publish(
+            self.vhost_name, method.exchange, method.routing_key,
+            props, command.body,
+            mandatory=method.mandatory, immediate=method.immediate,
+        )
+        if not routed and method.mandatory:
+            self.broker.metrics.returned_msgs += 1
+            self.send_command(AMQCommand(
+                channel.id,
+                am.Basic.Return(
+                    reply_code=int(ErrorCode.NO_ROUTE), reply_text="NO_ROUTE",
+                    exchange=method.exchange, routing_key=method.routing_key),
+                props, command.body))
+        elif not deliverable and method.immediate:
+            self.broker.metrics.returned_msgs += 1
+            self.send_command(AMQCommand(
+                channel.id,
+                am.Basic.Return(
+                    reply_code=int(ErrorCode.NO_CONSUMERS), reply_text="NO_CONSUMERS",
+                    exchange=method.exchange, routing_key=method.routing_key),
+                props, command.body))
+        if seq is not None:
+            # confirm after route+persist completed (multiple-coalescing
+            # happens naturally: the writer task batches consecutive acks
+            # into one TCP push)
+            self.send_method(channel.id, am.Basic.Ack(delivery_tag=seq, multiple=False))
+            self.broker.metrics.confirmed_msgs += 1
+
+    async def _on_consume(self, channel: ServerChannel, method: am.Basic.Consume) -> None:
+        queue = self.broker.get_queue(self.vhost_name, method.queue, self.id)
+        tag = method.consumer_tag or f"ctag-{self.id}-{channel.id}-{len(channel.consumers) + 1}"
+        if tag in channel.consumers:
+            raise ChannelError(
+                ErrorCode.NOT_ALLOWED, f"consumer tag '{tag}' in use",
+                method.CLASS_ID, method.METHOD_ID)
+        if queue.has_exclusive_consumer() or (method.exclusive and queue.consumers):
+            raise ChannelError(
+                ErrorCode.ACCESS_REFUSED,
+                f"queue '{queue.name}' has an exclusive consumer",
+                method.CLASS_ID, method.METHOD_ID)
+        consumer = Consumer(
+            tag, channel, queue, method.no_ack, method.exclusive, method.arguments)
+        channel.consumers[tag] = consumer
+        if not method.nowait:
+            self.send_method(channel.id, am.Basic.ConsumeOk(consumer_tag=tag))
+        queue.add_consumer(consumer)
+
+    def _on_get(self, channel: ServerChannel, method: am.Basic.Get) -> None:
+        queue = self.broker.get_queue(self.vhost_name, method.queue, self.id)
+        qm = queue.basic_get()
+        if qm is None:
+            self.send_method(channel.id, am.Basic.GetEmpty())
+            return
+        tag = channel.next_delivery_tag()
+        msg = qm.message
+        self.send_command(AMQCommand(
+            channel.id,
+            am.Basic.GetOk(
+                delivery_tag=tag, redelivered=qm.redelivered,
+                exchange=msg.exchange, routing_key=msg.routing_key,
+                message_count=queue.message_count),
+            msg.properties, msg.body))
+        self.broker.metrics.delivered(len(msg.body))
+        if method.no_ack:
+            self.broker.unrefer(msg)
+        else:
+            from .entities import Delivery
+
+            delivery = Delivery(qm, queue, channel, "", tag, no_ack=False)
+            channel.unacked[tag] = delivery
+            queue.outstanding[qm.offset] = delivery
+            if queue.durable and msg.persisted:
+                # mirror the consume dispatch path: the unacked message must
+                # survive a restart
+                self.broker.store_bg(self.broker.store.insert_queue_unacks(
+                    queue.vhost, queue.name,
+                    [(msg.id, qm.offset, len(msg.body), qm.expire_at_ms)]))
+
+    def _on_recover(self, channel: ServerChannel, requeue: bool) -> None:
+        """reference: FrameStage.scala:711-776."""
+        deliveries = [channel.unacked[t] for t in sorted(channel.unacked)]
+        if requeue:
+            for delivery in deliveries:
+                channel.requeue(delivery)
+        else:
+            for delivery in deliveries:
+                channel.redeliver(delivery)
+
+    # -- confirm / tx ------------------------------------------------------
+
+    def _on_confirm(self, command: AMQCommand) -> None:
+        method = command.method
+        channel = self._channel(command)
+        if isinstance(method, am.Confirm.Select):
+            if channel.mode == ChannelMode.TX:
+                raise ChannelError(
+                    ErrorCode.PRECONDITION_FAILED, "channel is transactional",
+                    method.CLASS_ID, method.METHOD_ID)
+            channel.mode = ChannelMode.CONFIRM
+            if not method.nowait:
+                self.send_method(command.channel, am.Confirm.SelectOk())
+        else:
+            raise HardError(
+                ErrorCode.COMMAND_INVALID, f"unexpected {method.NAME}",
+                method.CLASS_ID, method.METHOD_ID)
+
+    def _on_tx(self, command: AMQCommand) -> None:
+        # The reference stubs tx.* with TODO logs (FrameStage.scala:1261-1272);
+        # we reject cleanly so clients fail fast instead of silently.
+        method = command.method
+        self._channel(command)
+        raise ChannelError(
+            ErrorCode.NOT_IMPLEMENTED, "transactions not implemented",
+            method.CLASS_ID, method.METHOD_ID)

@@ -1,0 +1,437 @@
+"""Broker entities: Message, Queue, Exchange, VHost.
+
+Capability parity with the reference's entity actors:
+- Message         <- MessageEntity (entity/MessageEntity.scala:33-200):
+                     body held once, reference-counted per routed queue,
+                     deleted (and removed from store) at refcount 0.
+- Queue           <- QueueEntity (entity/QueueEntity.scala:34-488): ordered
+                     offsets, TTL clamp min(msg, queue), unacked bookkeeping,
+                     consumer registry with auto-delete, exclusive ownership,
+                     lastConsumed watermark persistence.
+- Exchange        <- ExchangeEntity (entity/ExchangeEntity.scala:66-410):
+                     typed matcher, durable-persistence decision, auto-delete
+                     on last unbind.
+- VHost           <- VhostEntity (entity/VhostEntity.scala:20-131) plus the
+                     per-vhost entity registries.
+
+Architectural difference, by design: the reference delivers by *polling*
+every out-active channel on a 1 microsecond tick (ServerBluePrint.scala:31-38,
+FrameStage.scala:366-453). Here each queue owns an event-driven dispatch
+step — enqueue/ack/consume/qos/flow events schedule one coalesced dispatch
+pass on the event loop (call_soon), which round-robins eligible consumers.
+No polling, no idle CPU burn, and delivery latency is one loop hop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from typing import TYPE_CHECKING, Any, Callable, Optional
+
+from ..amqp.properties import BasicProperties
+from .matchers import Matcher, matcher_for
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .broker import Broker
+    from .channel import Consumer, ServerChannel
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+class Message:
+    """A message body + properties, shared (refcounted) across queues."""
+
+    __slots__ = (
+        "id", "properties", "body", "exchange", "routing_key",
+        "ttl_ms", "refer_count", "persisted", "published_ns",
+    )
+
+    def __init__(
+        self,
+        id: int,
+        properties: BasicProperties,
+        body: bytes,
+        exchange: str,
+        routing_key: str,
+        ttl_ms: Optional[int] = None,
+    ) -> None:
+        self.id = id
+        self.properties = properties
+        self.body = body
+        self.exchange = exchange
+        self.routing_key = routing_key
+        self.ttl_ms = ttl_ms
+        self.refer_count = 0
+        self.persisted = False
+        self.published_ns = time.perf_counter_ns()
+
+    @property
+    def is_persistent(self) -> bool:
+        return self.properties.delivery_mode == 2
+
+
+class QueuedMessage:
+    """A message's residency in one queue (offset, expiry, redelivery mark)."""
+
+    __slots__ = ("message", "offset", "expire_at_ms", "redelivered")
+
+    def __init__(
+        self, message: Message, offset: int, expire_at_ms: Optional[int]
+    ) -> None:
+        self.message = message
+        self.offset = offset
+        self.expire_at_ms = expire_at_ms
+        self.redelivered = False
+
+    def is_expired(self, now: Optional[int] = None) -> bool:
+        return self.expire_at_ms is not None and (now or now_ms()) >= self.expire_at_ms
+
+
+class Delivery:
+    """An unacked delivery: the link channel<->queue for one message."""
+
+    __slots__ = ("queued", "queue", "channel", "consumer_tag", "delivery_tag", "no_ack")
+
+    def __init__(
+        self,
+        queued: QueuedMessage,
+        queue: "Queue",
+        channel: "ServerChannel",
+        consumer_tag: str,
+        delivery_tag: int,
+        no_ack: bool,
+    ) -> None:
+        self.queued = queued
+        self.queue = queue
+        self.channel = channel
+        self.consumer_tag = consumer_tag
+        self.delivery_tag = delivery_tag
+        self.no_ack = no_ack
+
+
+class Queue:
+    """One message queue within a vhost."""
+
+    def __init__(
+        self,
+        broker: "Broker",
+        vhost: str,
+        name: str,
+        *,
+        durable: bool = False,
+        exclusive_owner: Optional[int] = None,
+        auto_delete: bool = False,
+        ttl_ms: Optional[int] = None,
+        arguments: Optional[dict[str, Any]] = None,
+    ) -> None:
+        self.broker = broker
+        self.vhost = vhost
+        self.name = name
+        self.durable = durable
+        self.exclusive_owner = exclusive_owner  # connection id or None
+        self.auto_delete = auto_delete
+        self.ttl_ms = ttl_ms
+        self.arguments = arguments or {}
+
+        self.messages: deque[QueuedMessage] = deque()
+        self.next_offset = 1
+        self.last_consumed = 0
+        self.consumers: list["Consumer"] = []
+        self._rr_index = 0
+        self.outstanding: dict[int, Delivery] = {}  # msg offset -> delivery
+        self.had_consumer = False  # auto-delete arms only after first consumer
+        self.deleted = False
+        self._dispatch_scheduled = False
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def message_count(self) -> int:
+        self._expire_head()
+        return len(self.messages)
+
+    @property
+    def consumer_count(self) -> int:
+        return len(self.consumers)
+
+    def has_exclusive_consumer(self) -> bool:
+        return any(c.exclusive for c in self.consumers)
+
+    # -- enqueue ----------------------------------------------------------
+
+    def clamp_expiry(self, message: Message) -> Optional[int]:
+        """Effective expiry = now + min(per-message TTL, queue x-message-ttl)
+        (reference: QueueEntity.scala:288-297)."""
+        ttls = [t for t in (message.ttl_ms, self.ttl_ms) if t is not None]
+        if not ttls:
+            return None
+        return now_ms() + min(ttls)
+
+    def push(self, message: Message) -> QueuedMessage:
+        qm = QueuedMessage(message, self.next_offset, self.clamp_expiry(message))
+        self.next_offset += 1
+        self.messages.append(qm)
+        if self.durable and message.persisted:
+            self.broker.store_bg(
+                self.broker.store.insert_queue_msg(
+                    self.vhost, self.name, qm.offset, message.id,
+                    len(message.body), qm.expire_at_ms,
+                )
+            )
+        self.schedule_dispatch()
+        return qm
+
+    # -- dequeue / dispatch ------------------------------------------------
+
+    def _expire_head(self) -> None:
+        now = now_ms()
+        while self.messages and self.messages[0].is_expired(now):
+            qm = self.messages.popleft()
+            self._advance_watermark(qm)
+            self.broker.unrefer(qm.message)
+
+    def pop(self) -> Optional[QueuedMessage]:
+        """Pop the next live message (skipping+dropping expired heads)."""
+        self._expire_head()
+        if not self.messages:
+            return None
+        return self.messages.popleft()
+
+    def _advance_watermark(self, qm: QueuedMessage) -> None:
+        if qm.offset > self.last_consumed:
+            self.last_consumed = qm.offset
+            if self.durable:
+                self.broker.store_bg(
+                    self.broker.store.update_queue_last_consumed(
+                        self.vhost, self.name, self.last_consumed
+                    )
+                )
+
+    def schedule_dispatch(self) -> None:
+        if self._dispatch_scheduled or self.deleted:
+            return
+        if not self.messages or not self.consumers:
+            return
+        self._dispatch_scheduled = True
+        asyncio.get_event_loop().call_soon(self._dispatch)
+
+    def _dispatch(self) -> None:
+        """One coalesced dispatch pass: round-robin messages to eligible
+        consumers until either runs out (reference's fair poll,
+        AMQChannel.scala:43-48 + FrameStage.scala:380-443, turned inside out
+        into an event-driven push)."""
+        self._dispatch_scheduled = False
+        if self.deleted:
+            return
+        new_unacks: list[tuple[int, int, int, Optional[int]]] = []
+        while self.messages and self.consumers:
+            consumer = self._next_eligible_consumer()
+            if consumer is None:
+                break
+            qm = self.pop()
+            if qm is None:
+                break
+            delivery = consumer.channel.deliver(consumer, self, qm)
+            self._advance_watermark(qm)
+            if delivery is None:  # no_ack: consumed immediately
+                self.broker.unrefer(qm.message)
+            else:
+                self.outstanding[qm.offset] = delivery
+                if self.durable and qm.message.persisted:
+                    new_unacks.append(
+                        (qm.message.id, qm.offset, len(qm.message.body), qm.expire_at_ms)
+                    )
+        if new_unacks:
+            self.broker.store_bg(
+                self.broker.store.insert_queue_unacks(self.vhost, self.name, new_unacks)
+            )
+
+    def _next_eligible_consumer(self) -> Optional["Consumer"]:
+        n = len(self.consumers)
+        for i in range(n):
+            consumer = self.consumers[(self._rr_index + i) % n]
+            if consumer.can_take(self._head_size()):
+                self._rr_index = (self._rr_index + i + 1) % n
+                return consumer
+        return None
+
+    def _head_size(self) -> int:
+        self._expire_head()
+        return len(self.messages[0].message.body) if self.messages else 0
+
+    # -- get (polling read) ------------------------------------------------
+
+    def basic_get(self) -> Optional[QueuedMessage]:
+        qm = self.pop()
+        if qm is not None:
+            self._advance_watermark(qm)
+        return qm
+
+    # -- ack / requeue -----------------------------------------------------
+
+    def ack(self, delivery: Delivery) -> None:
+        self.outstanding.pop(delivery.queued.offset, None)
+        if self.durable and delivery.queued.message.persisted:
+            self.broker.store_bg(
+                self.broker.store.delete_queue_unacks(
+                    self.vhost, self.name, [delivery.queued.message.id]
+                )
+            )
+        self.broker.unrefer(delivery.queued.message)
+
+    def drop(self, delivery: Delivery) -> None:
+        """Reject without requeue: same store cleanup as ack."""
+        self.ack(delivery)
+
+    def requeue(self, delivery: Delivery) -> None:
+        """Return an unacked message to the queue, in offset order, marked
+        redelivered (reference: QueueEntity.scala:415-446)."""
+        self.outstanding.pop(delivery.queued.offset, None)
+        qm = delivery.queued
+        qm.redelivered = True
+        if qm.is_expired():
+            if self.durable and qm.message.persisted:
+                self.broker.store_bg(
+                    self.broker.store.delete_queue_unacks(
+                        self.vhost, self.name, [qm.message.id]
+                    )
+                )
+            self.broker.unrefer(qm.message)
+            return
+        # insert keeping offset order (requeues cluster near the head)
+        idx = 0
+        for idx, existing in enumerate(self.messages):
+            if existing.offset > qm.offset:
+                break
+        else:
+            idx = len(self.messages)
+        self.messages.insert(idx, qm)
+        # rewind the watermark so recovery replays it (reference rewinds
+        # lastConsumed on requeue)
+        if qm.offset <= self.last_consumed:
+            self.last_consumed = qm.offset - 1
+            if self.durable and qm.message.persisted:
+                self.broker.store_bg(
+                    self.broker.store.delete_queue_unacks(
+                        self.vhost, self.name, [qm.message.id]
+                    )
+                )
+                self.broker.store_bg(
+                    self.broker.store.insert_queue_msg(
+                        self.vhost, self.name, qm.offset, qm.message.id,
+                        len(qm.message.body), qm.expire_at_ms,
+                    )
+                )
+                self.broker.store_bg(
+                    self.broker.store.update_queue_last_consumed(
+                        self.vhost, self.name, self.last_consumed
+                    )
+                )
+        self.schedule_dispatch()
+
+    # -- purge / consumers -------------------------------------------------
+
+    def purge(self) -> int:
+        self._expire_head()
+        count = len(self.messages)
+        for qm in self.messages:
+            self._advance_watermark(qm)
+            self.broker.unrefer(qm.message)
+        self.messages.clear()
+        if self.durable:
+            self.broker.store_bg(
+                self.broker.store.purge_queue_msgs(self.vhost, self.name)
+            )
+        return count
+
+    def add_consumer(self, consumer: "Consumer") -> None:
+        self.consumers.append(consumer)
+        self.had_consumer = True
+        self.schedule_dispatch()
+
+    def remove_consumer(self, consumer: "Consumer") -> bool:
+        """Returns True if the queue auto-deleted as a result
+        (reference: QueueEntity.scala:236-269)."""
+        try:
+            self.consumers.remove(consumer)
+        except ValueError:
+            return False
+        if self.auto_delete and self.had_consumer and not self.consumers:
+            return True
+        return False
+
+
+class Exchange:
+    """One exchange within a vhost."""
+
+    def __init__(
+        self,
+        vhost: str,
+        name: str,
+        type: str,
+        *,
+        durable: bool = False,
+        auto_delete: bool = False,
+        internal: bool = False,
+        arguments: Optional[dict[str, Any]] = None,
+    ) -> None:
+        self.vhost = vhost
+        self.name = name
+        self.type = type
+        self.durable = durable
+        self.auto_delete = auto_delete
+        self.internal = internal
+        self.arguments = arguments or {}
+        self.matcher: Matcher = matcher_for(type)
+
+    def route(self, routing_key: str, headers: Optional[dict] = None) -> set[str]:
+        return self.matcher.route(routing_key, headers)
+
+    def equivalent(self, type: str, durable: bool, auto_delete: bool, internal: bool) -> bool:
+        return (
+            self.type == type.lower()
+            and self.durable == durable
+            and self.auto_delete == auto_delete
+            and self.internal == internal
+        )
+
+
+class VHost:
+    """A virtual host: independent namespace of exchanges and queues."""
+
+    # Exchanges every vhost predeclares. The default "" direct exchange binds
+    # every queue by its name (AMQP 0-9-1 mandated); amq.* are the standard
+    # predeclared set.
+    PREDECLARED: tuple[tuple[str, str], ...] = (
+        ("", "direct"),
+        ("amq.direct", "direct"),
+        ("amq.fanout", "fanout"),
+        ("amq.topic", "topic"),
+        ("amq.headers", "headers"),
+        ("amq.match", "headers"),
+    )
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.active = True
+        self.exchanges: dict[str, Exchange] = {}
+        self.queues: dict[str, Queue] = {}
+        for ex_name, ex_type in self.PREDECLARED:
+            self.exchanges[ex_name] = Exchange(
+                name, ex_name, ex_type, durable=True
+            )
+
+    def route(
+        self, exchange_name: str, routing_key: str, headers: Optional[dict] = None
+    ) -> Optional[set[str]]:
+        """Resolve target queue names; None when the exchange doesn't exist."""
+        exchange = self.exchanges.get(exchange_name)
+        if exchange is None:
+            return None
+        if exchange_name == "":
+            # default exchange: implicit binding queue-name == routing-key
+            return {routing_key} if routing_key in self.queues else set()
+        return exchange.route(routing_key, headers)

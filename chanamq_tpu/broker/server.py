@@ -1,0 +1,129 @@
+"""AMQP/AMQPS TCP listener.
+
+Capability parity with the reference's transport extension + process entry
+(chana-mq-base Amqp.scala:39-331 startServer/sslTlsStage; chana-mq-server
+AMQPServer.scala:39-111): plain AMQP listener (5672), optional TLS listener
+(5671), per-connection protocol engine instances, clean shutdown.
+
+Run standalone:  python -m chanamq_tpu.broker.server [--port 5672]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import ssl
+from typing import Optional
+
+from ..store.api import StoreService
+from .broker import Broker
+from .connection import AMQPConnection
+
+log = logging.getLogger("chanamq.server")
+
+
+class BrokerServer:
+    def __init__(
+        self,
+        broker: Optional[Broker] = None,
+        host: str = "0.0.0.0",
+        port: int = 5672,
+        *,
+        tls_port: Optional[int] = None,
+        ssl_context: Optional[ssl.SSLContext] = None,
+        heartbeat_s: int = 30,
+        frame_max: int = 131072,
+        channel_max: int = 2047,
+        store: Optional[StoreService] = None,
+    ) -> None:
+        self.broker = broker or Broker(store=store)
+        self.host = host
+        self.port = port
+        self.tls_port = tls_port
+        self.ssl_context = ssl_context
+        self.heartbeat_s = heartbeat_s
+        self.frame_max = frame_max
+        self.channel_max = channel_max
+        self._servers: list[asyncio.AbstractServer] = []
+        self._connections: set[AMQPConnection] = set()
+
+    async def start(self) -> None:
+        await self.broker.start()
+        server = await asyncio.start_server(self._on_client, self.host, self.port)
+        self._servers.append(server)
+        log.info("AMQP listening on %s:%d", self.host, self.port)
+        if self.tls_port is not None and self.ssl_context is not None:
+            tls_server = await asyncio.start_server(
+                self._on_client, self.host, self.tls_port, ssl=self.ssl_context)
+            self._servers.append(tls_server)
+            log.info("AMQPS listening on %s:%d", self.host, self.tls_port)
+
+    @property
+    def bound_port(self) -> int:
+        return self._servers[0].sockets[0].getsockname()[1]
+
+    async def _on_client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        connection = AMQPConnection(
+            self.broker, reader, writer,
+            heartbeat_s=self.heartbeat_s, frame_max=self.frame_max,
+            channel_max=self.channel_max,
+        )
+        self._connections.add(connection)
+        try:
+            await connection.serve()
+        finally:
+            self._connections.discard(connection)
+
+    async def stop(self) -> None:
+        for server in self._servers:
+            server.close()
+        # kick live connections first: in py3.12 Server.wait_closed() waits
+        # for all connection handlers, which only finish once clients drop
+        for connection in list(self._connections):
+            connection.closing = True
+            try:
+                connection.writer.close()
+            except Exception:
+                pass
+        for server in self._servers:
+            await server.wait_closed()
+        self._servers.clear()
+        await self.broker.stop()
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await self.stop()
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="chanamq-tpu AMQP broker")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=5672)
+    parser.add_argument("--store", default=None, help="sqlite db path (default: in-memory transient)")
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=args.log_level,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+
+    store: Optional[StoreService] = None
+    if args.store:
+        from ..store.sqlite import SqliteStore
+
+        store = SqliteStore(args.store)
+    server = BrokerServer(host=args.host, port=args.port, store=store)
+    try:
+        asyncio.run(server.serve_forever())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,566 @@
+"""Asyncio AMQP 0-9-1 client.
+
+A full protocol client over the same wire codec the server uses (the codec is
+shared; the protocol logic — RPC matching, consumer delivery routing, confirm
+tracking — is independent). Mirrors the client capability the reference got
+from the RabbitMQ Java client plus its own ClientSettings
+(chana-mq-base Settings.scala:200-219).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ssl as ssl_module
+from dataclasses import dataclass
+from typing import Any, Awaitable, Callable, Optional, Union
+
+from ..amqp.command import AMQCommand, CommandAssembler
+from ..amqp.constants import FrameType, PROTOCOL_HEADER
+from ..amqp.frame import Frame, FrameError, FrameParser, HEARTBEAT_BYTES
+from ..amqp import methods as am
+from ..amqp.properties import BasicProperties
+
+
+class AMQPClientError(Exception):
+    pass
+
+
+class ChannelClosedError(AMQPClientError):
+    def __init__(self, reply_code: int, reply_text: str) -> None:
+        super().__init__(f"channel closed: {reply_code} {reply_text}")
+        self.reply_code = reply_code
+        self.reply_text = reply_text
+
+
+class ConnectionClosedError(AMQPClientError):
+    def __init__(self, reply_code: int = 0, reply_text: str = "") -> None:
+        super().__init__(f"connection closed: {reply_code} {reply_text}")
+        self.reply_code = reply_code
+        self.reply_text = reply_text
+
+
+@dataclass(slots=True)
+class DeliveredMessage:
+    consumer_tag: str
+    delivery_tag: int
+    redelivered: bool
+    exchange: str
+    routing_key: str
+    properties: BasicProperties
+    body: bytes
+    message_count: Optional[int] = None  # set for basic.get replies
+
+
+@dataclass(slots=True)
+class ReturnedMessage:
+    reply_code: int
+    reply_text: str
+    exchange: str
+    routing_key: str
+    properties: BasicProperties
+    body: bytes
+
+
+ConsumerCallback = Callable[[DeliveredMessage], Union[None, Awaitable[None]]]
+
+
+class AMQPClient:
+    """One client connection. Use `await AMQPClient.connect(...)`."""
+
+    def __init__(self) -> None:
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self._parser = FrameParser()
+        self._assembler = CommandAssembler()
+        self.channels: dict[int, "ClientChannel"] = {}
+        self._next_channel = 1
+        self._free_channel_ids: list[int] = []
+        self.frame_max = 131072
+        self.channel_max = 2047
+        self.heartbeat_s = 0
+        self.server_properties: dict[str, Any] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._heartbeat_task: Optional[asyncio.Task] = None
+        self._conn_waiters: list[tuple[tuple[type, ...], asyncio.Future]] = []
+        self.closed = False
+        self._close_exc: Optional[Exception] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    async def connect(
+        cls,
+        host: str = "127.0.0.1",
+        port: int = 5672,
+        *,
+        vhost: str = "/",
+        username: str = "guest",
+        password: str = "guest",
+        heartbeat: Optional[int] = None,  # None: accept server's; 0: disable
+        ssl: Optional[ssl_module.SSLContext] = None,
+        client_properties: Optional[dict] = None,
+    ) -> "AMQPClient":
+        self = cls()
+        self.reader, self.writer = await asyncio.open_connection(host, port, ssl=ssl)
+        self.writer.write(PROTOCOL_HEADER)
+        await self.writer.drain()
+        self._reader_task = asyncio.create_task(self._read_loop())
+
+        start = await self._wait_connection_method((am.Connection.Start,))
+        self.server_properties = start.server_properties
+        mechanisms = bytes(start.mechanisms).split()
+        mech = b"PLAIN" if b"PLAIN" in mechanisms else mechanisms[0]
+        response = b"\x00" + username.encode() + b"\x00" + password.encode() \
+            if mech == b"PLAIN" else b""
+        self._send_method(0, am.Connection.StartOk(
+            client_properties=client_properties or {"product": "chanamq-tpu-client"},
+            mechanism=mech.decode(), response=response, locale="en_US",
+        ))
+        tune = await self._wait_connection_method((am.Connection.Tune,))
+        self.channel_max = tune.channel_max or 2047
+        self.frame_max = tune.frame_max or 131072
+        self._parser.frame_max = self.frame_max
+        self.heartbeat_s = tune.heartbeat if heartbeat is None else heartbeat
+        self._send_method(0, am.Connection.TuneOk(
+            channel_max=self.channel_max, frame_max=self.frame_max,
+            heartbeat=self.heartbeat_s,
+        ))
+        self._send_method(0, am.Connection.Open(virtual_host=vhost))
+        await self._wait_connection_method((am.Connection.OpenOk,))
+        if self.heartbeat_s:
+            self._heartbeat_task = asyncio.create_task(self._heartbeat_loop())
+        return self
+
+    async def close(self) -> None:
+        if self.closed or self.writer is None:
+            return
+        try:
+            self._send_method(0, am.Connection.Close(reply_code=200, reply_text="bye"))
+            await self._wait_connection_method((am.Connection.CloseOk,), timeout=2)
+        except Exception:
+            pass
+        await self._shutdown(None)
+
+    async def _shutdown(self, exc: Optional[Exception]) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self._close_exc = exc
+        if self._heartbeat_task:
+            self._heartbeat_task.cancel()
+        for channel in list(self.channels.values()):
+            channel._connection_lost(exc)
+        self.channels.clear()
+        for _, fut in self._conn_waiters:
+            if not fut.done():
+                if exc:
+                    fut.set_exception(exc)
+                else:
+                    fut.set_exception(ConnectionClosedError())
+        self._conn_waiters.clear()
+        if self.writer is not None:
+            try:
+                self.writer.close()
+                await self.writer.wait_closed()
+            except Exception:
+                pass
+        if self._reader_task and asyncio.current_task() is not self._reader_task:
+            self._reader_task.cancel()
+
+    # -- channels ----------------------------------------------------------
+
+    async def channel(self) -> "ClientChannel":
+        if self.closed:
+            raise self._close_exc or ConnectionClosedError()
+        if self._free_channel_ids:
+            cid = self._free_channel_ids.pop()
+        else:
+            if self._next_channel > self.channel_max:
+                raise AMQPClientError(
+                    f"out of channel ids (channel_max={self.channel_max})")
+            cid = self._next_channel
+            self._next_channel += 1
+        channel = ClientChannel(self, cid)
+        self.channels[cid] = channel
+        self._send_method(cid, am.Channel.Open())
+        await channel._wait((am.Channel.OpenOk,))
+        return channel
+
+    # -- wire I/O ----------------------------------------------------------
+
+    def _send_method(self, channel: int, method: am.Method) -> None:
+        assert self.writer is not None
+        self.writer.write(Frame.method(channel, method.encode()).to_bytes())
+
+    def _send_command(self, command: AMQCommand) -> None:
+        assert self.writer is not None
+        self.writer.write(command.render(self.frame_max))
+
+    async def _read_loop(self) -> None:
+        assert self.reader is not None
+        try:
+            while True:
+                data = await self.reader.read(65536)
+                if not data:
+                    await self._shutdown(ConnectionClosedError(0, "server closed"))
+                    return
+                for item in self._parser.feed(data):
+                    if isinstance(item, FrameError):
+                        await self._shutdown(
+                            ConnectionClosedError(int(item.code), item.message))
+                        return
+                    if item.type == FrameType.HEARTBEAT:
+                        continue
+                    for out in self._assembler.feed(item):
+                        if isinstance(out, FrameError):
+                            await self._shutdown(
+                                ConnectionClosedError(int(out.code), out.message))
+                            return
+                        await self._on_command(out)
+        except asyncio.CancelledError:
+            pass
+        except Exception as exc:
+            await self._shutdown(exc)
+
+    async def _on_command(self, command: AMQCommand) -> None:
+        method = command.method
+        if command.channel == 0:
+            if isinstance(method, am.Connection.Close):
+                self._send_method(0, am.Connection.CloseOk())
+                await self._shutdown(
+                    ConnectionClosedError(method.reply_code, method.reply_text))
+                return
+            for i, (types, fut) in enumerate(self._conn_waiters):
+                if isinstance(method, types) and not fut.done():
+                    self._conn_waiters.pop(i)
+                    fut.set_result(method)
+                    return
+            return
+        channel = self.channels.get(command.channel)
+        if channel is not None:
+            await channel._on_command(command)
+
+    async def _wait_connection_method(
+        self, types: tuple[type, ...], timeout: float = 10
+    ) -> Any:
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._conn_waiters.append((types, fut))
+        return await asyncio.wait_for(fut, timeout)
+
+    async def _heartbeat_loop(self) -> None:
+        try:
+            while not self.closed:
+                await asyncio.sleep(max(self.heartbeat_s / 2, 0.5))
+                if self.writer is not None:
+                    self.writer.write(HEARTBEAT_BYTES)
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+
+
+class ClientChannel:
+    """One channel on a client connection."""
+
+    def __init__(self, client: AMQPClient, channel_id: int) -> None:
+        self.client = client
+        self.id = channel_id
+        self.closed = False
+        self.close_reason: Optional[ChannelClosedError] = None
+        self._waiters: list[tuple[tuple[type, ...], asyncio.Future]] = []
+        self._consumers: dict[str, ConsumerCallback] = {}
+        # deliveries racing the consume-ok -> registration gap are buffered
+        self._pending_deliveries: dict[str, list[DeliveredMessage]] = {}
+        self.returns: list[ReturnedMessage] = []
+        # confirm mode
+        self.confirm_mode = False
+        self._publish_seq = 0
+        self._confirm_waiters: dict[int, asyncio.Future] = {}
+        self.unconfirmed: set[int] = set()
+
+    # -- RPC plumbing ------------------------------------------------------
+
+    async def _wait(self, types: tuple[type, ...], timeout: float = 10) -> Any:
+        if self.closed:
+            raise self.close_reason or ChannelClosedError(0, "closed")
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._waiters.append((types, fut))
+        return await asyncio.wait_for(fut, timeout)
+
+    def _send(self, method: am.Method) -> None:
+        if self.closed:
+            raise self.close_reason or ChannelClosedError(0, "closed")
+        self.client._send_method(self.id, method)
+
+    async def _rpc(self, method: am.Method, reply_types: tuple[type, ...]) -> Any:
+        self._send(method)
+        return await self._wait(reply_types)
+
+    async def _on_command(self, command: AMQCommand) -> None:
+        method = command.method
+        if isinstance(method, am.Basic.Deliver):
+            msg = DeliveredMessage(
+                consumer_tag=method.consumer_tag,
+                delivery_tag=method.delivery_tag,
+                redelivered=method.redelivered,
+                exchange=method.exchange,
+                routing_key=method.routing_key,
+                properties=command.properties or BasicProperties(),
+                body=command.body,
+            )
+            callback = self._consumers.get(method.consumer_tag)
+            if callback is not None:
+                result = callback(msg)
+                if asyncio.iscoroutine(result):
+                    await result
+            else:
+                self._pending_deliveries.setdefault(
+                    method.consumer_tag, []).append(msg)
+            return
+        if isinstance(method, am.Basic.Return):
+            self.returns.append(ReturnedMessage(
+                reply_code=method.reply_code, reply_text=method.reply_text,
+                exchange=method.exchange, routing_key=method.routing_key,
+                properties=command.properties or BasicProperties(),
+                body=command.body,
+            ))
+            return
+        if isinstance(method, am.Basic.Ack) and self.confirm_mode:
+            self._on_confirm(method.delivery_tag, method.multiple, nack=False)
+            return
+        if isinstance(method, am.Basic.Nack) and self.confirm_mode:
+            self._on_confirm(method.delivery_tag, method.multiple, nack=True)
+            return
+        if isinstance(method, am.Channel.Close):
+            self.client._send_method(self.id, am.Channel.CloseOk())
+            self._closed_by_server(
+                ChannelClosedError(method.reply_code, method.reply_text))
+            return
+        if isinstance(method, am.Channel.Flow):
+            self.client._send_method(self.id, am.Channel.FlowOk(active=method.active))
+            return
+        if isinstance(method, (am.Basic.GetOk, am.Basic.GetEmpty)):
+            for i, (types, fut) in enumerate(self._waiters):
+                if isinstance(method, types) and not fut.done():
+                    self._waiters.pop(i)
+                    if isinstance(method, am.Basic.GetOk):
+                        fut.set_result(DeliveredMessage(
+                            consumer_tag="",
+                            delivery_tag=method.delivery_tag,
+                            redelivered=method.redelivered,
+                            exchange=method.exchange,
+                            routing_key=method.routing_key,
+                            properties=command.properties or BasicProperties(),
+                            body=command.body,
+                            message_count=method.message_count,
+                        ))
+                    else:
+                        fut.set_result(None)
+                    return
+            return
+        for i, (types, fut) in enumerate(self._waiters):
+            if isinstance(method, types) and not fut.done():
+                self._waiters.pop(i)
+                fut.set_result(method)
+                return
+
+    def _closed_by_server(self, exc: ChannelClosedError) -> None:
+        self.closed = True
+        self.close_reason = exc
+        if self.client.channels.pop(self.id, None) is not None:
+            self.client._free_channel_ids.append(self.id)
+        for _, fut in self._waiters:
+            if not fut.done():
+                fut.set_exception(exc)
+        self._waiters.clear()
+        for fut in self._confirm_waiters.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._confirm_waiters.clear()
+
+    def _connection_lost(self, exc: Optional[Exception]) -> None:
+        self._closed_by_server(
+            exc if isinstance(exc, ChannelClosedError)
+            else ChannelClosedError(0, str(exc) if exc else "connection closed"))
+
+    # -- confirm tracking --------------------------------------------------
+
+    def _on_confirm(self, delivery_tag: int, multiple: bool, nack: bool) -> None:
+        tags = (
+            [t for t in self.unconfirmed if t <= delivery_tag]
+            if multiple else [delivery_tag]
+        )
+        for tag in tags:
+            self.unconfirmed.discard(tag)
+            fut = self._confirm_waiters.pop(tag, None)
+            if fut is not None and not fut.done():
+                if nack:
+                    fut.set_exception(AMQPClientError(f"publish {tag} nacked"))
+                else:
+                    fut.set_result(True)
+
+    # -- channel ops -------------------------------------------------------
+
+    async def close(self) -> None:
+        if self.closed:
+            return
+        try:
+            await self._rpc(
+                am.Channel.Close(reply_code=200, reply_text="bye"),
+                (am.Channel.CloseOk,))
+        finally:
+            self.closed = True
+            if self.client.channels.pop(self.id, None) is not None:
+                self.client._free_channel_ids.append(self.id)
+
+    async def flow(self, active: bool) -> bool:
+        ok = await self._rpc(am.Channel.Flow(active=active), (am.Channel.FlowOk,))
+        return ok.active
+
+    # -- exchange ops ------------------------------------------------------
+
+    async def exchange_declare(
+        self, exchange: str, type: str = "direct", *, passive: bool = False,
+        durable: bool = False, auto_delete: bool = False, internal: bool = False,
+        arguments: Optional[dict] = None,
+    ) -> None:
+        await self._rpc(am.Exchange.Declare(
+            exchange=exchange, type=type, passive=passive, durable=durable,
+            auto_delete=auto_delete, internal=internal, arguments=arguments,
+        ), (am.Exchange.DeclareOk,))
+
+    async def exchange_delete(self, exchange: str, *, if_unused: bool = False) -> None:
+        await self._rpc(am.Exchange.Delete(exchange=exchange, if_unused=if_unused),
+                        (am.Exchange.DeleteOk,))
+
+    # -- queue ops ---------------------------------------------------------
+
+    async def queue_declare(
+        self, queue: str = "", *, passive: bool = False, durable: bool = False,
+        exclusive: bool = False, auto_delete: bool = False,
+        arguments: Optional[dict] = None,
+    ) -> am.Method:
+        """Returns DeclareOk (fields: queue, message_count, consumer_count)."""
+        return await self._rpc(am.Queue.Declare(
+            queue=queue, passive=passive, durable=durable, exclusive=exclusive,
+            auto_delete=auto_delete, arguments=arguments,
+        ), (am.Queue.DeclareOk,))
+
+    async def queue_bind(
+        self, queue: str, exchange: str, routing_key: str = "",
+        arguments: Optional[dict] = None,
+    ) -> None:
+        await self._rpc(am.Queue.Bind(
+            queue=queue, exchange=exchange, routing_key=routing_key,
+            arguments=arguments,
+        ), (am.Queue.BindOk,))
+
+    async def queue_unbind(
+        self, queue: str, exchange: str, routing_key: str = "",
+        arguments: Optional[dict] = None,
+    ) -> None:
+        await self._rpc(am.Queue.Unbind(
+            queue=queue, exchange=exchange, routing_key=routing_key,
+            arguments=arguments,
+        ), (am.Queue.UnbindOk,))
+
+    async def queue_purge(self, queue: str) -> int:
+        ok = await self._rpc(am.Queue.Purge(queue=queue), (am.Queue.PurgeOk,))
+        return ok.message_count
+
+    async def queue_delete(
+        self, queue: str, *, if_unused: bool = False, if_empty: bool = False
+    ) -> int:
+        ok = await self._rpc(am.Queue.Delete(
+            queue=queue, if_unused=if_unused, if_empty=if_empty,
+        ), (am.Queue.DeleteOk,))
+        return ok.message_count
+
+    # -- basic ops ---------------------------------------------------------
+
+    async def basic_qos(
+        self, *, prefetch_size: int = 0, prefetch_count: int = 0,
+        global_: bool = False,
+    ) -> None:
+        await self._rpc(am.Basic.Qos(
+            prefetch_size=prefetch_size, prefetch_count=prefetch_count,
+            global_=global_,
+        ), (am.Basic.QosOk,))
+
+    def basic_publish(
+        self, body: bytes, *, exchange: str = "", routing_key: str = "",
+        properties: Optional[BasicProperties] = None,
+        mandatory: bool = False, immediate: bool = False,
+    ) -> Optional[int]:
+        """Fire-and-forget publish. In confirm mode returns the seq number."""
+        self.client._send_command(AMQCommand(
+            self.id,
+            am.Basic.Publish(
+                exchange=exchange, routing_key=routing_key,
+                mandatory=mandatory, immediate=immediate),
+            properties or BasicProperties(),
+            body,
+        ))
+        if self.confirm_mode:
+            self._publish_seq += 1
+            self.unconfirmed.add(self._publish_seq)
+            return self._publish_seq
+        return None
+
+    async def basic_publish_confirmed(
+        self, body: bytes, *, exchange: str = "", routing_key: str = "",
+        properties: Optional[BasicProperties] = None,
+        mandatory: bool = False, immediate: bool = False, timeout: float = 10,
+    ) -> None:
+        """Publish and await the broker confirm (requires confirm_select)."""
+        seq = self.basic_publish(
+            body, exchange=exchange, routing_key=routing_key,
+            properties=properties, mandatory=mandatory, immediate=immediate)
+        assert seq is not None, "confirm_select first"
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._confirm_waiters[seq] = fut
+        await asyncio.wait_for(fut, timeout)
+
+    async def basic_consume(
+        self, queue: str, callback: ConsumerCallback, *,
+        consumer_tag: str = "", no_ack: bool = False, exclusive: bool = False,
+        arguments: Optional[dict] = None,
+    ) -> str:
+        ok = await self._rpc(am.Basic.Consume(
+            queue=queue, consumer_tag=consumer_tag, no_ack=no_ack,
+            exclusive=exclusive, arguments=arguments,
+        ), (am.Basic.ConsumeOk,))
+        self._consumers[ok.consumer_tag] = callback
+        for msg in self._pending_deliveries.pop(ok.consumer_tag, []):
+            result = callback(msg)
+            if asyncio.iscoroutine(result):
+                await result
+        return ok.consumer_tag
+
+    async def basic_cancel(self, consumer_tag: str) -> None:
+        await self._rpc(am.Basic.Cancel(consumer_tag=consumer_tag),
+                        (am.Basic.CancelOk,))
+        self._consumers.pop(consumer_tag, None)
+
+    async def basic_get(
+        self, queue: str, *, no_ack: bool = False
+    ) -> Optional[DeliveredMessage]:
+        self._send(am.Basic.Get(queue=queue, no_ack=no_ack))
+        return await self._wait((am.Basic.GetOk, am.Basic.GetEmpty))
+
+    def basic_ack(self, delivery_tag: int, *, multiple: bool = False) -> None:
+        self._send(am.Basic.Ack(delivery_tag=delivery_tag, multiple=multiple))
+
+    def basic_nack(
+        self, delivery_tag: int, *, multiple: bool = False, requeue: bool = True
+    ) -> None:
+        self._send(am.Basic.Nack(
+            delivery_tag=delivery_tag, multiple=multiple, requeue=requeue))
+
+    def basic_reject(self, delivery_tag: int, *, requeue: bool = True) -> None:
+        self._send(am.Basic.Reject(delivery_tag=delivery_tag, requeue=requeue))
+
+    async def basic_recover(self, *, requeue: bool = True) -> None:
+        await self._rpc(am.Basic.Recover(requeue=requeue), (am.Basic.RecoverOk,))
+
+    async def confirm_select(self) -> None:
+        await self._rpc(am.Confirm.Select(), (am.Confirm.SelectOk,))
+        self.confirm_mode = True

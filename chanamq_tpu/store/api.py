@@ -1,0 +1,175 @@
+"""Abstract store interface.
+
+Capability parity with the reference's `DBOpService` trait
+(chana-mq-server .../store/package.scala:15-43), which exposes ~21 async
+operations over messages, queue metas/messages/unacks, exchanges, binds and
+vhosts. This interface keeps the same functional surface with an async
+Python shape; writes on durable mutations are awaited by the broker before
+acknowledging (the reference's Cassandra impl secretly blocked —
+CassandraOpService.scala:753-755 — a scar SURVEY.md §7.3 says to avoid).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass(slots=True)
+class StoredMessage:
+    id: int
+    properties_raw: bytes  # encoded content-header payload (props + body size)
+    body: bytes
+    exchange: str
+    routing_key: str
+    refer_count: int
+    ttl_ms: Optional[int] = None
+
+
+@dataclass(slots=True)
+class StoredQueue:
+    vhost: str
+    name: str
+    durable: bool = True
+    exclusive: bool = False
+    auto_delete: bool = False
+    ttl_ms: Optional[int] = None
+    last_consumed: int = 0
+    arguments: dict[str, Any] = field(default_factory=dict)
+    # (offset, msg_id, body_size, expire_at_ms|None) of pending messages
+    msgs: list[tuple[int, int, int, Optional[int]]] = field(default_factory=list)
+    # msg_id -> (offset, body_size, expire_at_ms|None)
+    unacks: dict[int, tuple[int, int, Optional[int]]] = field(default_factory=dict)
+
+
+@dataclass(slots=True)
+class StoredExchange:
+    vhost: str
+    name: str
+    type: str
+    durable: bool = True
+    auto_delete: bool = False
+    internal: bool = False
+    arguments: dict[str, Any] = field(default_factory=dict)
+    # (routing_key, queue, arguments)
+    binds: list[tuple[str, str, Optional[dict]]] = field(default_factory=list)
+
+
+class StoreService:
+    """Pluggable durable store. All methods are coroutines."""
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def open(self) -> None: ...
+
+    async def close(self) -> None: ...
+
+    # -- messages (refcounted blobs; reference: insertMessage/selectMessage/
+    #    deleteMessage + referMessage/unreferMessage) ----------------------
+
+    async def insert_message(self, msg: StoredMessage) -> None:
+        raise NotImplementedError
+
+    async def select_message(self, msg_id: int) -> Optional[StoredMessage]:
+        raise NotImplementedError
+
+    async def delete_message(self, msg_id: int) -> None:
+        raise NotImplementedError
+
+    async def update_message_refer_count(self, msg_id: int, count: int) -> None:
+        raise NotImplementedError
+
+    # -- queue meta (reference: insertQueueMeta/selectQueueMeta/deleteQueueMeta)
+
+    async def insert_queue_meta(self, q: StoredQueue) -> None:
+        raise NotImplementedError
+
+    async def select_queue(self, vhost: str, name: str) -> Optional[StoredQueue]:
+        """Reconstruct meta + pending msgs + unacks (reference: selectQueue)."""
+        raise NotImplementedError
+
+    async def all_queues(self, vhost: Optional[str] = None) -> list[StoredQueue]:
+        raise NotImplementedError
+
+    # -- queue message log (reference: insertQueueMsg/deleteQueueMsg) ------
+
+    async def insert_queue_msg(
+        self, vhost: str, queue: str, offset: int, msg_id: int,
+        body_size: int, expire_at_ms: Optional[int],
+    ) -> None:
+        raise NotImplementedError
+
+    async def delete_queue_msg(self, vhost: str, queue: str, offset: int) -> None:
+        raise NotImplementedError
+
+    # -- consumption watermark + unacks (reference: updateQueueLastConsumed,
+    #    insertQueueUnack/deleteQueueUnack) --------------------------------
+
+    async def update_queue_last_consumed(
+        self, vhost: str, queue: str, last_consumed: int
+    ) -> None:
+        raise NotImplementedError
+
+    async def insert_queue_unacks(
+        self, vhost: str, queue: str,
+        unacks: list[tuple[int, int, int, Optional[int]]],
+    ) -> None:
+        """unacks: (msg_id, offset, body_size, expire_at_ms|None)."""
+        raise NotImplementedError
+
+    async def delete_queue_unacks(
+        self, vhost: str, queue: str, msg_ids: list[int]
+    ) -> None:
+        raise NotImplementedError
+
+    # -- queue delete with archival (reference: pendingDeleteQueue copies
+    #    rows into *_deleted tables before deleting, then forceDeleteQueue)
+
+    async def archive_queue(self, vhost: str, queue: str) -> None:
+        raise NotImplementedError
+
+    async def delete_queue(self, vhost: str, queue: str) -> None:
+        raise NotImplementedError
+
+    async def purge_queue_msgs(self, vhost: str, queue: str) -> None:
+        raise NotImplementedError
+
+    # -- exchanges + binds (reference: insertExchange/selectExchange/
+    #    deleteExchange, insertExchangeBind/deleteExchangeBind) ------------
+
+    async def insert_exchange(self, ex: StoredExchange) -> None:
+        raise NotImplementedError
+
+    async def select_exchange(self, vhost: str, name: str) -> Optional[StoredExchange]:
+        raise NotImplementedError
+
+    async def all_exchanges(self, vhost: Optional[str] = None) -> list[StoredExchange]:
+        raise NotImplementedError
+
+    async def delete_exchange(self, vhost: str, name: str) -> None:
+        raise NotImplementedError
+
+    async def insert_bind(
+        self, vhost: str, exchange: str, queue: str, routing_key: str,
+        arguments: Optional[dict],
+    ) -> None:
+        raise NotImplementedError
+
+    async def delete_bind(
+        self, vhost: str, exchange: str, queue: str, routing_key: str
+    ) -> None:
+        raise NotImplementedError
+
+    async def delete_queue_binds(self, vhost: str, queue: str) -> None:
+        raise NotImplementedError
+
+    # -- vhosts (reference: insertVhost/selectAllVhosts/deleteVhost) -------
+
+    async def insert_vhost(self, name: str, active: bool = True) -> None:
+        raise NotImplementedError
+
+    async def all_vhosts(self) -> list[tuple[str, bool]]:
+        raise NotImplementedError
+
+    async def delete_vhost(self, name: str) -> None:
+        raise NotImplementedError

@@ -1,0 +1,166 @@
+"""In-memory StoreService — transient mode and test double.
+
+Functionally complete (everything select_queue / recovery needs works), just
+not durable across process restarts. Useful for unit tests and as the broker
+default when no store is configured.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from .api import StoredExchange, StoredMessage, StoredQueue, StoreService
+
+
+class MemoryStore(StoreService):
+    def __init__(self) -> None:
+        self.messages: dict[int, StoredMessage] = {}
+        self.queues: dict[tuple[str, str], StoredQueue] = {}
+        self.exchanges: dict[tuple[str, str], StoredExchange] = {}
+        self.vhosts: dict[str, bool] = {}
+        self.archived: dict[tuple[str, str], StoredQueue] = {}
+
+    async def open(self) -> None:
+        pass
+
+    async def close(self) -> None:
+        pass
+
+    # -- messages ---------------------------------------------------------
+
+    async def insert_message(self, msg: StoredMessage) -> None:
+        self.messages[msg.id] = copy.copy(msg)
+
+    async def select_message(self, msg_id: int) -> Optional[StoredMessage]:
+        msg = self.messages.get(msg_id)
+        return copy.copy(msg) if msg else None
+
+    async def delete_message(self, msg_id: int) -> None:
+        self.messages.pop(msg_id, None)
+
+    async def update_message_refer_count(self, msg_id: int, count: int) -> None:
+        msg = self.messages.get(msg_id)
+        if msg:
+            msg.refer_count = count
+
+    # -- queue meta -------------------------------------------------------
+
+    async def insert_queue_meta(self, q: StoredQueue) -> None:
+        existing = self.queues.get((q.vhost, q.name))
+        stored = copy.deepcopy(q)
+        if existing:
+            stored.msgs = existing.msgs
+            stored.unacks = existing.unacks
+        self.queues[(q.vhost, q.name)] = stored
+
+    async def select_queue(self, vhost: str, name: str) -> Optional[StoredQueue]:
+        q = self.queues.get((vhost, name))
+        return copy.deepcopy(q) if q else None
+
+    async def all_queues(self, vhost: Optional[str] = None) -> list[StoredQueue]:
+        return [
+            copy.deepcopy(q)
+            for (vh, _), q in self.queues.items()
+            if vhost is None or vh == vhost
+        ]
+
+    # -- queue log --------------------------------------------------------
+
+    async def insert_queue_msg(self, vhost, queue, offset, msg_id, body_size, expire_at_ms) -> None:
+        q = self.queues.get((vhost, queue))
+        if q:
+            q.msgs.append((offset, msg_id, body_size, expire_at_ms))
+
+    async def delete_queue_msg(self, vhost, queue, offset) -> None:
+        q = self.queues.get((vhost, queue))
+        if q:
+            q.msgs = [m for m in q.msgs if m[0] != offset]
+
+    # -- watermark + unacks ------------------------------------------------
+
+    async def update_queue_last_consumed(self, vhost, queue, last_consumed) -> None:
+        q = self.queues.get((vhost, queue))
+        if q:
+            q.last_consumed = last_consumed
+            q.msgs = [m for m in q.msgs if m[0] > last_consumed]
+
+    async def insert_queue_unacks(self, vhost, queue, unacks) -> None:
+        q = self.queues.get((vhost, queue))
+        if q:
+            for msg_id, offset, body_size, expire_at_ms in unacks:
+                q.unacks[msg_id] = (offset, body_size, expire_at_ms)
+
+    async def delete_queue_unacks(self, vhost, queue, msg_ids) -> None:
+        q = self.queues.get((vhost, queue))
+        if q:
+            for msg_id in msg_ids:
+                q.unacks.pop(msg_id, None)
+
+    # -- delete/archive ----------------------------------------------------
+
+    async def archive_queue(self, vhost, queue) -> None:
+        q = self.queues.get((vhost, queue))
+        if q:
+            self.archived[(vhost, queue)] = copy.deepcopy(q)
+
+    async def delete_queue(self, vhost, queue) -> None:
+        self.queues.pop((vhost, queue), None)
+
+    async def purge_queue_msgs(self, vhost, queue) -> None:
+        q = self.queues.get((vhost, queue))
+        if q:
+            q.msgs = []
+
+    # -- exchanges + binds -------------------------------------------------
+
+    async def insert_exchange(self, ex: StoredExchange) -> None:
+        existing = self.exchanges.get((ex.vhost, ex.name))
+        stored = copy.deepcopy(ex)
+        if existing:
+            stored.binds = existing.binds
+        self.exchanges[(ex.vhost, ex.name)] = stored
+
+    async def select_exchange(self, vhost, name) -> Optional[StoredExchange]:
+        ex = self.exchanges.get((vhost, name))
+        return copy.deepcopy(ex) if ex else None
+
+    async def all_exchanges(self, vhost: Optional[str] = None) -> list[StoredExchange]:
+        return [
+            copy.deepcopy(ex)
+            for (vh, _), ex in self.exchanges.items()
+            if vhost is None or vh == vhost
+        ]
+
+    async def delete_exchange(self, vhost, name) -> None:
+        self.exchanges.pop((vhost, name), None)
+
+    async def insert_bind(self, vhost, exchange, queue, routing_key, arguments) -> None:
+        ex = self.exchanges.get((vhost, exchange))
+        if ex is not None:
+            entry = (routing_key, queue, arguments)
+            if entry not in ex.binds:
+                ex.binds.append(entry)
+
+    async def delete_bind(self, vhost, exchange, queue, routing_key) -> None:
+        ex = self.exchanges.get((vhost, exchange))
+        if ex is not None:
+            ex.binds = [
+                b for b in ex.binds if not (b[0] == routing_key and b[1] == queue)
+            ]
+
+    async def delete_queue_binds(self, vhost, queue) -> None:
+        for (vh, _), ex in self.exchanges.items():
+            if vh == vhost:
+                ex.binds = [b for b in ex.binds if b[1] != queue]
+
+    # -- vhosts ------------------------------------------------------------
+
+    async def insert_vhost(self, name: str, active: bool = True) -> None:
+        self.vhosts[name] = active
+
+    async def all_vhosts(self) -> list[tuple[str, bool]]:
+        return list(self.vhosts.items())
+
+    async def delete_vhost(self, name: str) -> None:
+        self.vhosts.pop(name, None)

@@ -1,0 +1,364 @@
+"""SQLite StoreService — the durable backend.
+
+Capability parity with the reference's CassandraOpService
+(chana-mq-server .../store/cassandra/CassandraOpService.scala:46-756): same
+schema shape — message blobs + refcount, queue log keyed (queue, offset),
+queue metas with a lastConsumed watermark, unacks, binds, vhosts, and
+*_deleted archival copies on queue delete (pendingDeleteQueue,
+CassandraOpService.scala:561-604).
+
+Design difference from the reference, on purpose: the reference's `execute`
+blocked its calling thread while pretending to be async
+(CassandraOpService.scala:753-755). Here every operation runs on ONE
+dedicated writer thread (FIFO), so (a) the asyncio event loop never blocks,
+and (b) writes are strictly ordered — the explicit write-ordering story
+SURVEY.md §7.3 calls for. TTL expiry is a stored expire_at timestamp filtered
+on read (the analogue of Cassandra row TTL).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sqlite3
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Optional, TypeVar
+
+from .api import StoredExchange, StoredMessage, StoredQueue, StoreService
+
+T = TypeVar("T")
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS msgs (
+  id INTEGER PRIMARY KEY, header BLOB, body BLOB,
+  exchange TEXT, routing_key TEXT, refer_count INTEGER, ttl_ms INTEGER
+);
+CREATE TABLE IF NOT EXISTS queue_metas (
+  vhost TEXT, name TEXT, durable INTEGER, exclusive_ INTEGER,
+  auto_delete INTEGER, ttl_ms INTEGER, last_consumed INTEGER,
+  arguments TEXT, PRIMARY KEY (vhost, name)
+);
+CREATE TABLE IF NOT EXISTS queue_msgs (
+  vhost TEXT, queue TEXT, offset INTEGER, msg_id INTEGER,
+  body_size INTEGER, expire_at_ms INTEGER,
+  PRIMARY KEY (vhost, queue, offset)
+);
+CREATE TABLE IF NOT EXISTS queue_unacks (
+  vhost TEXT, queue TEXT, msg_id INTEGER, offset INTEGER,
+  body_size INTEGER, expire_at_ms INTEGER,
+  PRIMARY KEY (vhost, queue, msg_id)
+);
+CREATE TABLE IF NOT EXISTS exchanges (
+  vhost TEXT, name TEXT, type TEXT, durable INTEGER,
+  auto_delete INTEGER, internal INTEGER, arguments TEXT,
+  PRIMARY KEY (vhost, name)
+);
+CREATE TABLE IF NOT EXISTS binds (
+  vhost TEXT, exchange TEXT, queue TEXT, routing_key TEXT, arguments TEXT,
+  PRIMARY KEY (vhost, exchange, queue, routing_key)
+);
+CREATE TABLE IF NOT EXISTS vhosts (name TEXT PRIMARY KEY, active INTEGER);
+CREATE TABLE IF NOT EXISTS queue_metas_deleted (
+  vhost TEXT, name TEXT, meta TEXT, PRIMARY KEY (vhost, name)
+);
+CREATE TABLE IF NOT EXISTS queue_msgs_deleted (
+  vhost TEXT, queue TEXT, offset INTEGER, msg_id INTEGER,
+  body_size INTEGER, expire_at_ms INTEGER,
+  PRIMARY KEY (vhost, queue, offset)
+);
+CREATE TABLE IF NOT EXISTS queue_unacks_deleted (
+  vhost TEXT, queue TEXT, msg_id INTEGER, offset INTEGER,
+  body_size INTEGER, expire_at_ms INTEGER,
+  PRIMARY KEY (vhost, queue, msg_id)
+);
+"""
+
+
+class SqliteStore(StoreService):
+    def __init__(self, path: str = ":memory:") -> None:
+        self.path = path
+        self._db: Optional[sqlite3.Connection] = None
+        # single writer thread => strict FIFO op ordering
+        self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="store")
+
+    async def _exec(self, fn: Callable[[sqlite3.Connection], T]) -> T:
+        loop = asyncio.get_running_loop()
+        db = self._db
+        assert db is not None, "store not opened"
+        return await loop.run_in_executor(self._executor, lambda: fn(db))
+
+    async def open(self) -> None:
+        def _open() -> sqlite3.Connection:
+            db = sqlite3.connect(self.path, check_same_thread=False)
+            db.execute("PRAGMA journal_mode=WAL")
+            db.execute("PRAGMA synchronous=NORMAL")
+            db.executescript(_SCHEMA)
+            db.commit()
+            return db
+
+        loop = asyncio.get_running_loop()
+        self._db = await loop.run_in_executor(self._executor, _open)
+
+    async def close(self) -> None:
+        if self._db is not None:
+            db = self._db
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(self._executor, db.close)
+            self._db = None
+        self._executor.shutdown(wait=False)
+
+    # -- messages ---------------------------------------------------------
+
+    async def insert_message(self, msg: StoredMessage) -> None:
+        await self._exec(lambda db: db.execute(
+            "INSERT OR REPLACE INTO msgs VALUES (?,?,?,?,?,?,?)",
+            (msg.id, msg.properties_raw, msg.body, msg.exchange,
+             msg.routing_key, msg.refer_count, msg.ttl_ms),
+        ).connection.commit())
+
+    async def select_message(self, msg_id: int) -> Optional[StoredMessage]:
+        def q(db: sqlite3.Connection):
+            row = db.execute("SELECT * FROM msgs WHERE id=?", (msg_id,)).fetchone()
+            return row
+
+        row = await self._exec(q)
+        if row is None:
+            return None
+        return StoredMessage(
+            id=row[0], properties_raw=row[1], body=row[2], exchange=row[3],
+            routing_key=row[4], refer_count=row[5], ttl_ms=row[6],
+        )
+
+    async def delete_message(self, msg_id: int) -> None:
+        await self._exec(lambda db: db.execute(
+            "DELETE FROM msgs WHERE id=?", (msg_id,)).connection.commit())
+
+    async def update_message_refer_count(self, msg_id: int, count: int) -> None:
+        await self._exec(lambda db: db.execute(
+            "UPDATE msgs SET refer_count=? WHERE id=?", (count, msg_id)
+        ).connection.commit())
+
+    # -- queue meta -------------------------------------------------------
+
+    async def insert_queue_meta(self, q: StoredQueue) -> None:
+        await self._exec(lambda db: db.execute(
+            "INSERT OR REPLACE INTO queue_metas VALUES (?,?,?,?,?,?,?,?)",
+            (q.vhost, q.name, int(q.durable), int(q.exclusive),
+             int(q.auto_delete), q.ttl_ms, q.last_consumed,
+             json.dumps(q.arguments)),
+        ).connection.commit())
+
+    async def select_queue(self, vhost: str, name: str) -> Optional[StoredQueue]:
+        def q(db: sqlite3.Connection):
+            meta = db.execute(
+                "SELECT * FROM queue_metas WHERE vhost=? AND name=?",
+                (vhost, name)).fetchone()
+            if meta is None:
+                return None
+            msgs = db.execute(
+                "SELECT offset, msg_id, body_size, expire_at_ms FROM queue_msgs "
+                "WHERE vhost=? AND queue=? AND offset>? ORDER BY offset",
+                (vhost, name, meta[6])).fetchall()
+            unacks = db.execute(
+                "SELECT msg_id, offset, body_size, expire_at_ms FROM queue_unacks "
+                "WHERE vhost=? AND queue=?", (vhost, name)).fetchall()
+            return meta, msgs, unacks
+
+        out = await self._exec(q)
+        if out is None:
+            return None
+        meta, msgs, unacks = out
+        return StoredQueue(
+            vhost=meta[0], name=meta[1], durable=bool(meta[2]),
+            exclusive=bool(meta[3]), auto_delete=bool(meta[4]), ttl_ms=meta[5],
+            last_consumed=meta[6], arguments=json.loads(meta[7] or "{}"),
+            msgs=[tuple(m) for m in msgs],
+            unacks={u[0]: (u[1], u[2], u[3]) for u in unacks},
+        )
+
+    async def all_queues(self, vhost: Optional[str] = None) -> list[StoredQueue]:
+        def q(db: sqlite3.Connection):
+            if vhost is None:
+                return db.execute("SELECT vhost, name FROM queue_metas").fetchall()
+            return db.execute(
+                "SELECT vhost, name FROM queue_metas WHERE vhost=?", (vhost,)
+            ).fetchall()
+
+        names = await self._exec(q)
+        out = []
+        for vh, name in names:
+            sq = await self.select_queue(vh, name)
+            if sq:
+                out.append(sq)
+        return out
+
+    # -- queue log --------------------------------------------------------
+
+    async def insert_queue_msg(self, vhost, queue, offset, msg_id, body_size, expire_at_ms) -> None:
+        await self._exec(lambda db: db.execute(
+            "INSERT OR REPLACE INTO queue_msgs VALUES (?,?,?,?,?,?)",
+            (vhost, queue, offset, msg_id, body_size, expire_at_ms),
+        ).connection.commit())
+
+    async def delete_queue_msg(self, vhost, queue, offset) -> None:
+        await self._exec(lambda db: db.execute(
+            "DELETE FROM queue_msgs WHERE vhost=? AND queue=? AND offset=?",
+            (vhost, queue, offset)).connection.commit())
+
+    # -- watermark + unacks ------------------------------------------------
+
+    async def update_queue_last_consumed(self, vhost, queue, last_consumed) -> None:
+        def w(db: sqlite3.Connection):
+            db.execute(
+                "UPDATE queue_metas SET last_consumed=? WHERE vhost=? AND name=?",
+                (last_consumed, vhost, queue))
+            db.execute(
+                "DELETE FROM queue_msgs WHERE vhost=? AND queue=? AND offset<=?",
+                (vhost, queue, last_consumed))
+            db.commit()
+
+        await self._exec(w)
+
+    async def insert_queue_unacks(self, vhost, queue, unacks) -> None:
+        def w(db: sqlite3.Connection):
+            db.executemany(
+                "INSERT OR REPLACE INTO queue_unacks VALUES (?,?,?,?,?,?)",
+                [(vhost, queue, m, o, s, e) for (m, o, s, e) in unacks])
+            db.commit()
+
+        await self._exec(w)
+
+    async def delete_queue_unacks(self, vhost, queue, msg_ids) -> None:
+        def w(db: sqlite3.Connection):
+            db.executemany(
+                "DELETE FROM queue_unacks WHERE vhost=? AND queue=? AND msg_id=?",
+                [(vhost, queue, m) for m in msg_ids])
+            db.commit()
+
+        await self._exec(w)
+
+    # -- delete/archive ----------------------------------------------------
+
+    async def archive_queue(self, vhost, queue) -> None:
+        def w(db: sqlite3.Connection):
+            meta = db.execute(
+                "SELECT * FROM queue_metas WHERE vhost=? AND name=?",
+                (vhost, queue)).fetchone()
+            if meta:
+                db.execute(
+                    "INSERT OR REPLACE INTO queue_metas_deleted VALUES (?,?,?)",
+                    (vhost, queue, json.dumps(list(meta))))
+            db.execute(
+                "INSERT OR REPLACE INTO queue_msgs_deleted "
+                "SELECT * FROM queue_msgs WHERE vhost=? AND queue=?",
+                (vhost, queue))
+            db.execute(
+                "INSERT OR REPLACE INTO queue_unacks_deleted "
+                "SELECT * FROM queue_unacks WHERE vhost=? AND queue=?",
+                (vhost, queue))
+            db.commit()
+
+        await self._exec(w)
+
+    async def delete_queue(self, vhost, queue) -> None:
+        def w(db: sqlite3.Connection):
+            db.execute("DELETE FROM queue_metas WHERE vhost=? AND name=?", (vhost, queue))
+            db.execute("DELETE FROM queue_msgs WHERE vhost=? AND queue=?", (vhost, queue))
+            db.execute("DELETE FROM queue_unacks WHERE vhost=? AND queue=?", (vhost, queue))
+            db.commit()
+
+        await self._exec(w)
+
+    async def purge_queue_msgs(self, vhost, queue) -> None:
+        await self._exec(lambda db: db.execute(
+            "DELETE FROM queue_msgs WHERE vhost=? AND queue=?", (vhost, queue)
+        ).connection.commit())
+
+    # -- exchanges + binds -------------------------------------------------
+
+    async def insert_exchange(self, ex: StoredExchange) -> None:
+        await self._exec(lambda db: db.execute(
+            "INSERT OR REPLACE INTO exchanges VALUES (?,?,?,?,?,?,?)",
+            (ex.vhost, ex.name, ex.type, int(ex.durable), int(ex.auto_delete),
+             int(ex.internal), json.dumps(ex.arguments)),
+        ).connection.commit())
+
+    async def select_exchange(self, vhost, name) -> Optional[StoredExchange]:
+        def q(db: sqlite3.Connection):
+            row = db.execute(
+                "SELECT * FROM exchanges WHERE vhost=? AND name=?",
+                (vhost, name)).fetchone()
+            if row is None:
+                return None
+            binds = db.execute(
+                "SELECT routing_key, queue, arguments FROM binds "
+                "WHERE vhost=? AND exchange=?", (vhost, name)).fetchall()
+            return row, binds
+
+        out = await self._exec(q)
+        if out is None:
+            return None
+        row, binds = out
+        return StoredExchange(
+            vhost=row[0], name=row[1], type=row[2], durable=bool(row[3]),
+            auto_delete=bool(row[4]), internal=bool(row[5]),
+            arguments=json.loads(row[6] or "{}"),
+            binds=[(b[0], b[1], json.loads(b[2]) if b[2] else None) for b in binds],
+        )
+
+    async def all_exchanges(self, vhost: Optional[str] = None) -> list[StoredExchange]:
+        def q(db: sqlite3.Connection):
+            if vhost is None:
+                return db.execute("SELECT vhost, name FROM exchanges").fetchall()
+            return db.execute(
+                "SELECT vhost, name FROM exchanges WHERE vhost=?", (vhost,)
+            ).fetchall()
+
+        names = await self._exec(q)
+        out = []
+        for vh, name in names:
+            ex = await self.select_exchange(vh, name)
+            if ex:
+                out.append(ex)
+        return out
+
+    async def delete_exchange(self, vhost, name) -> None:
+        def w(db: sqlite3.Connection):
+            db.execute("DELETE FROM exchanges WHERE vhost=? AND name=?", (vhost, name))
+            db.execute("DELETE FROM binds WHERE vhost=? AND exchange=?", (vhost, name))
+            db.commit()
+
+        await self._exec(w)
+
+    async def insert_bind(self, vhost, exchange, queue, routing_key, arguments) -> None:
+        await self._exec(lambda db: db.execute(
+            "INSERT OR REPLACE INTO binds VALUES (?,?,?,?,?)",
+            (vhost, exchange, queue, routing_key,
+             json.dumps(arguments) if arguments else None),
+        ).connection.commit())
+
+    async def delete_bind(self, vhost, exchange, queue, routing_key) -> None:
+        await self._exec(lambda db: db.execute(
+            "DELETE FROM binds WHERE vhost=? AND exchange=? AND queue=? AND routing_key=?",
+            (vhost, exchange, queue, routing_key)).connection.commit())
+
+    async def delete_queue_binds(self, vhost, queue) -> None:
+        await self._exec(lambda db: db.execute(
+            "DELETE FROM binds WHERE vhost=? AND queue=?", (vhost, queue)
+        ).connection.commit())
+
+    # -- vhosts ------------------------------------------------------------
+
+    async def insert_vhost(self, name: str, active: bool = True) -> None:
+        await self._exec(lambda db: db.execute(
+            "INSERT OR REPLACE INTO vhosts VALUES (?,?)", (name, int(active))
+        ).connection.commit())
+
+    async def all_vhosts(self) -> list[tuple[str, bool]]:
+        rows = await self._exec(
+            lambda db: db.execute("SELECT name, active FROM vhosts").fetchall())
+        return [(r[0], bool(r[1])) for r in rows]
+
+    async def delete_vhost(self, name: str) -> None:
+        await self._exec(lambda db: db.execute(
+            "DELETE FROM vhosts WHERE name=?", (name,)).connection.commit())

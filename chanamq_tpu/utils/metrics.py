@@ -1,0 +1,96 @@
+"""Broker metrics registry: counters + latency histogram.
+
+The reference has no metrics subsystem — throughput was measured by grepping
+log lines (SURVEY.md §5 "observability", chana-mq-test/perf/sum-published.sh)
+and no latency measurement existed at all. This registry supplies what
+BASELINE.md needs: publish/deliver counters and publish->deliver latency
+percentiles, with negligible hot-path cost.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class Histogram:
+    """Fixed-bucket log-scale latency histogram (microseconds)."""
+
+    # bucket upper bounds in us: 1,2,5,10,...,1e7 (10 s), +inf
+    BOUNDS = [
+        1, 2, 5, 10, 20, 50, 100, 200, 500,
+        1_000, 2_000, 5_000, 10_000, 20_000, 50_000,
+        100_000, 200_000, 500_000, 1_000_000, 2_000_000, 5_000_000, 10_000_000,
+    ]
+
+    def __init__(self) -> None:
+        self.buckets = [0] * (len(self.BOUNDS) + 1)
+        self.count = 0
+        self.total_us = 0
+
+    def observe_us(self, us: float) -> None:
+        self.count += 1
+        self.total_us += int(us)
+        for i, bound in enumerate(self.BOUNDS):
+            if us <= bound:
+                self.buckets[i] += 1
+                return
+        self.buckets[-1] += 1
+
+    def percentile_us(self, p: float) -> Optional[float]:
+        """Upper-bound estimate of the p-quantile (p in [0,1])."""
+        if self.count == 0:
+            return None
+        target = p * self.count
+        seen = 0
+        for i, n in enumerate(self.buckets):
+            seen += n
+            if seen >= target:
+                return float(self.BOUNDS[i]) if i < len(self.BOUNDS) else float("inf")
+        return float("inf")
+
+    @property
+    def mean_us(self) -> Optional[float]:
+        return self.total_us / self.count if self.count else None
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self.published_msgs = 0
+        self.published_bytes = 0
+        self.delivered_msgs = 0
+        self.delivered_bytes = 0
+        self.returned_msgs = 0
+        self.confirmed_msgs = 0
+        self.expired_msgs = 0
+        self.connections_opened = 0
+        self.connections_closed = 0
+        self.publish_to_deliver_us = Histogram()
+        self.started_at = time.time()
+
+    def published(self, nbytes: int) -> None:
+        self.published_msgs += 1
+        self.published_bytes += nbytes
+
+    def delivered(self, nbytes: int) -> None:
+        self.delivered_msgs += 1
+        self.delivered_bytes += nbytes
+
+    def snapshot(self) -> dict:
+        elapsed = time.time() - self.started_at
+        h = self.publish_to_deliver_us
+        return {
+            "uptime_s": round(elapsed, 3),
+            "published_msgs": self.published_msgs,
+            "published_bytes": self.published_bytes,
+            "delivered_msgs": self.delivered_msgs,
+            "delivered_bytes": self.delivered_bytes,
+            "returned_msgs": self.returned_msgs,
+            "confirmed_msgs": self.confirmed_msgs,
+            "expired_msgs": self.expired_msgs,
+            "connections_opened": self.connections_opened,
+            "connections_closed": self.connections_closed,
+            "publish_to_deliver_p50_us": h.percentile_us(0.50),
+            "publish_to_deliver_p99_us": h.percentile_us(0.99),
+            "publish_to_deliver_mean_us": h.mean_us,
+        }

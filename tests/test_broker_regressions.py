@@ -1,0 +1,93 @@
+"""Regression tests for broker defects found in review."""
+
+import asyncio
+
+import pytest
+
+from chanamq_tpu.amqp import methods as am
+from chanamq_tpu.amqp.frame import Frame
+from chanamq_tpu.broker.server import BrokerServer
+from chanamq_tpu.client import AMQPClient
+from chanamq_tpu.client.client import ChannelClosedError
+
+pytestmark = pytest.mark.asyncio
+
+
+@pytest.fixture
+async def server():
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    yield srv
+    await srv.stop()
+
+
+@pytest.fixture
+async def client(server):
+    c = await AMQPClient.connect("127.0.0.1", server.bound_port)
+    yield c
+    await c.close()
+
+
+async def test_delete_queue_with_autodelete_exchange_does_not_crash(client):
+    """Auto-delete exchange whose last binding dies with the queue: the
+    queue delete must complete and the exchange must auto-delete."""
+    ch = await client.channel()
+    await ch.exchange_declare("auto_ex", "direct", auto_delete=True)
+    await ch.queue_declare("only_q")
+    await ch.queue_bind("only_q", "auto_ex", "k")
+    count = await ch.queue_delete("only_q")  # used to RuntimeError server-side
+    assert count == 0
+    with pytest.raises(ChannelClosedError) as exc_info:
+        await ch.exchange_declare("auto_ex", "direct", passive=True)
+    assert exc_info.value.reply_code == 404
+
+
+async def test_client_heartbeat_zero_not_timed_out():
+    """A client negotiating heartbeat=0 must not be disconnected while idle,
+    even when the server has a (tiny) configured heartbeat."""
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=1)
+    await srv.start()
+    try:
+        c = await AMQPClient.connect("127.0.0.1", srv.bound_port, heartbeat=0)
+        # client explicitly asked for heartbeat=0 in tune-ok
+        assert c.heartbeat_s == 0
+        await asyncio.sleep(2.5)  # > 2x server heartbeat interval
+        ch = await c.channel()  # connection must still be alive
+        ok = await ch.queue_declare("still_alive")
+        assert ok.queue == "still_alive"
+        await c.close()
+    finally:
+        await srv.stop()
+
+
+async def test_pipelined_commands_after_soft_error_are_discarded(client):
+    """Commands already pipelined on a channel that just got a soft
+    Channel.Close must be discarded, not escalate to a connection error."""
+    ch = await client.channel()
+    # two commands in one write: first triggers 404, second is pipelined junk
+    client._send_method(ch.id, am.Basic.Get(queue="missing_q"))
+    client._send_method(ch.id, am.Queue.Declare(queue="pipelined_q"))
+    await asyncio.sleep(0.2)
+    assert ch.closed
+    assert ch.close_reason.reply_code == 404
+    # the connection survived; a fresh channel works
+    ch2 = await client.channel()
+    ok = await ch2.queue_declare("post_error_q")
+    assert ok.queue == "post_error_q"
+
+
+async def test_client_channel_ids_are_reused(server):
+    c = await AMQPClient.connect("127.0.0.1", server.bound_port)
+    try:
+        c.channel_max = 8  # tiny budget: without reuse this exhausts fast
+        for _ in range(50):
+            ch = await c.channel()
+            await ch.close()
+        assert c._next_channel <= 3
+    finally:
+        await c.close()
+
+
+async def test_async_fixture_with_request_param(request):
+    """conftest shim must pass `request` through to async fixtures/tests."""
+    assert request.node.name == "test_async_fixture_with_request_param"

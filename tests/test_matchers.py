@@ -1,0 +1,130 @@
+"""Routing matcher unit tests (the reference's TrieMatcher.main self-test
+coverage, QueueMatcher.scala:75-139, extended with '#' and headers)."""
+
+from chanamq_tpu.broker.matchers import (
+    DirectMatcher,
+    FanoutMatcher,
+    HeadersMatcher,
+    TopicMatcher,
+    matcher_for,
+)
+
+
+def test_direct_exact_match():
+    m = DirectMatcher()
+    assert m.bind("k1", "q1")
+    assert not m.bind("k1", "q1")  # duplicate
+    m.bind("k1", "q2")
+    m.bind("k2", "q3")
+    assert m.route("k1") == {"q1", "q2"}
+    assert m.route("k2") == {"q3"}
+    assert m.route("k3") == set()
+    assert m.unbind("k1", "q1")
+    assert not m.unbind("k1", "q1")
+    assert m.route("k1") == {"q2"}
+
+
+def test_fanout_ignores_key():
+    m = FanoutMatcher()
+    m.bind("a", "q1")
+    m.bind("b", "q2")
+    assert m.route("anything") == {"q1", "q2"}
+    m.unbind("a", "q1")
+    assert m.route("x") == {"q2"}
+
+
+def test_fanout_multiple_keys_same_queue():
+    m = FanoutMatcher()
+    m.bind("a", "q1")
+    m.bind("b", "q1")
+    m.unbind("a", "q1")
+    assert m.route("x") == {"q1"}  # still bound via key b
+    m.unbind("b", "q1")
+    assert m.route("x") == set()
+
+
+def test_topic_star_single_word():
+    m = TopicMatcher()
+    m.bind("stock.*.nyse", "q1")
+    assert m.route("stock.ibm.nyse") == {"q1"}
+    assert m.route("stock.goog.nyse") == {"q1"}
+    assert m.route("stock.nyse") == set()
+    assert m.route("stock.ibm.x.nyse") == set()
+
+
+def test_topic_exact_and_star_coexist():
+    m = TopicMatcher()
+    m.bind("a.b.c", "exact")
+    m.bind("a.*.c", "star")
+    m.bind("*.b.c", "star2")
+    assert m.route("a.b.c") == {"exact", "star", "star2"}
+    assert m.route("a.x.c") == {"star"}
+    assert m.route("z.b.c") == {"star2"}
+
+
+def test_topic_hash_zero_or_more():
+    m = TopicMatcher()
+    m.bind("stock.#", "all_stock")
+    m.bind("#", "everything")
+    m.bind("#.nyse", "nyse_suffix")
+    assert m.route("stock") == {"all_stock", "everything"}
+    assert m.route("stock.ibm") == {"all_stock", "everything"}
+    assert m.route("stock.ibm.nyse") == {"all_stock", "everything", "nyse_suffix"}
+    assert m.route("nyse") == {"everything", "nyse_suffix"}
+    assert m.route("bond") == {"everything"}
+
+
+def test_topic_hash_middle():
+    m = TopicMatcher()
+    m.bind("a.#.z", "q")
+    assert m.route("a.z") == {"q"}
+    assert m.route("a.b.z") == {"q"}
+    assert m.route("a.b.c.z") == {"q"}
+    assert m.route("a.b") == set()
+
+
+def test_topic_unbind_prunes():
+    m = TopicMatcher()
+    m.bind("a.b.c", "q1")
+    m.bind("a.b", "q2")
+    assert m.unbind("a.b.c", "q1")
+    assert m.route("a.b.c") == set()
+    assert m.route("a.b") == {"q2"}
+    assert not m.unbind("a.b.c", "q1")
+    # internal trie pruned back to just a.b
+    assert m.bindings() == [("a.b", "q2", None)]
+
+
+def test_topic_unbind_queue_bulk():
+    m = TopicMatcher()
+    m.bind("a.*", "q1")
+    m.bind("b.*", "q1")
+    m.bind("a.*", "q2")
+    assert m.unbind_queue("q1") == 2
+    assert m.route("a.x") == {"q2"}
+    assert m.route("b.x") == set()
+
+
+def test_headers_all_match():
+    m = HeadersMatcher()
+    m.bind("", "q1", {"x-match": "all", "type": "report", "fmt": "pdf"})
+    assert m.route("", {"type": "report", "fmt": "pdf"}) == {"q1"}
+    assert m.route("", {"type": "report", "fmt": "pdf", "extra": 1}) == {"q1"}
+    assert m.route("", {"type": "report"}) == set()
+    assert m.route("", {"type": "memo", "fmt": "pdf"}) == set()
+
+
+def test_headers_any_match():
+    m = HeadersMatcher()
+    m.bind("", "q1", {"x-match": "any", "a": 1, "b": 2})
+    assert m.route("", {"a": 1}) == {"q1"}
+    assert m.route("", {"b": 2, "c": 3}) == {"q1"}
+    assert m.route("", {"a": 9}) == set()
+    assert m.route("", {}) == set()
+
+
+def test_matcher_factory():
+    assert isinstance(matcher_for("direct"), DirectMatcher)
+    assert isinstance(matcher_for("fanout"), FanoutMatcher)
+    assert isinstance(matcher_for("topic"), TopicMatcher)
+    assert isinstance(matcher_for("headers"), HeadersMatcher)
