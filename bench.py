@@ -1,0 +1,255 @@
+#!/usr/bin/env python3
+"""Broker benchmark harness — the reference's PerfTest matrix, multi-process.
+
+Reproduces the shape of the reference's perf specs
+(chana-mq-test/perf/publish-consume-spec*.js: {autoAck, manual-ack} x
+{transient, persistent}, 3 producers, 3 consumers transient / 1 consumer
+persistent, prefetch 5000) against this broker. Like the reference's
+RabbitMQ PerfTest, every producer/consumer is its OWN process talking to the
+broker process over real sockets, publishers pace themselves with a
+publisher-confirm window, and latency is measured client-side from a
+timestamp embedded in the message body (publish -> deliver, end to end).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": msgs/s, "unit": "msgs/s", "vs_baseline": null, ...}
+vs_baseline is null because the reference publishes no numbers
+(BASELINE.md: "harness only").
+
+Env knobs: BENCH_SECONDS (default 5), BENCH_BODY_BYTES (default 100),
+BENCH_SPECS ("a" = headline transient/autoAck only, "all" = full matrix),
+BENCH_CONFIRM_WINDOW (default 2000).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BENCH_SECONDS = float(os.environ.get("BENCH_SECONDS", "5"))
+BODY_BYTES = max(16, int(os.environ.get("BENCH_BODY_BYTES", "100")))
+CONFIRM_WINDOW = int(os.environ.get("BENCH_CONFIRM_WINDOW", "2000"))
+PREFETCH = 5000
+
+SPECS = {
+    # name -> (auto_ack, persistent, producers, consumers); mirrors the
+    # reference's four spec files
+    "transient_autoack_3p3c": (True, False, 3, 3),
+    "transient_ack_3p3c": (False, False, 3, 3),
+    "persistent_autoack_3p1c": (True, True, 3, 1),
+    "persistent_ack_3p1c": (False, True, 3, 1),
+}
+
+
+# ---------------------------------------------------------------------------
+# child roles
+# ---------------------------------------------------------------------------
+
+
+async def producer_main(port: int, persistent: bool, seconds: float) -> None:
+    from chanamq_tpu.amqp.properties import BasicProperties
+    from chanamq_tpu.client import AMQPClient
+
+    c = await AMQPClient.connect("127.0.0.1", port)
+    ch = await c.channel()
+    await ch.confirm_select()
+    props = BasicProperties(delivery_mode=2 if persistent else 1)
+    pad = b"x" * (BODY_BYTES - 8)
+    deadline = time.perf_counter() + seconds
+    published = 0
+    while time.perf_counter() < deadline:
+        body = time.time_ns().to_bytes(8, "big") + pad
+        ch.basic_publish(body, exchange="bench_ex", routing_key="bench",
+                         properties=props)
+        published += 1
+        if len(ch.unconfirmed) >= CONFIRM_WINDOW:
+            await c.writer.drain()
+            await ch.wait_unconfirmed_below(CONFIRM_WINDOW // 2)
+    await c.writer.drain()
+    try:
+        await ch.wait_unconfirmed_below(1, timeout=15)
+    except asyncio.TimeoutError:
+        pass
+    await c.close()
+    print(json.dumps({"role": "producer", "published": published}), flush=True)
+
+
+async def consumer_main(port: int, auto_ack: bool, seconds: float) -> None:
+    from chanamq_tpu.client import AMQPClient
+
+    c = await AMQPClient.connect("127.0.0.1", port)
+    ch = await c.channel()
+    if not auto_ack:
+        await ch.basic_qos(prefetch_count=PREFETCH)
+    delivered = 0
+    latencies: list[int] = []
+
+    def on_msg(msg) -> None:
+        nonlocal delivered
+        delivered += 1
+        latencies.append(time.time_ns() - int.from_bytes(msg.body[:8], "big"))
+        if not auto_ack and delivered % 500 == 0:
+            ch.basic_ack(msg.delivery_tag, multiple=True)
+
+    await ch.basic_consume("bench_q", on_msg, no_ack=auto_ack)
+    # run until producers are done plus drain time
+    await asyncio.sleep(seconds + 3)
+    if not auto_ack and delivered:
+        ch.basic_ack(0, multiple=True)
+        await asyncio.sleep(0.2)
+    await c.close()
+    latencies.sort()
+    n = len(latencies)
+    stats = {
+        "role": "consumer",
+        "delivered": delivered,
+        "p50_us": latencies[n // 2] / 1000 if n else None,
+        "p99_us": latencies[min(n - 1, int(n * 0.99))] / 1000 if n else None,
+        "max_us": latencies[-1] / 1000 if n else None,
+    }
+    print(json.dumps(stats), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+
+def wait_port(port: int, timeout: float = 15) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.2).close()
+            return
+        except OSError:
+            time.sleep(0.05)
+    raise RuntimeError("broker did not come up")
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def setup_topology(port: int, persistent: bool) -> None:
+    from chanamq_tpu.client import AMQPClient
+
+    c = await AMQPClient.connect("127.0.0.1", port)
+    ch = await c.channel()
+    await ch.exchange_declare("bench_ex", "direct", durable=persistent)
+    await ch.queue_declare("bench_q", durable=persistent)
+    await ch.queue_bind("bench_q", "bench_ex", "bench")
+    await c.close()
+
+
+def run_spec(name: str) -> dict:
+    auto_ack, persistent, producers, consumers = SPECS[name]
+    port = free_port()
+    env = {**os.environ, "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))}
+    broker_args = [sys.executable, "-m", "chanamq_tpu.broker.server",
+                   "--host", "127.0.0.1", "--port", str(port),
+                   "--log-level", "WARNING"]
+    store_file = None
+    if persistent:
+        tmp = tempfile.NamedTemporaryFile(suffix=".db", delete=False)
+        tmp.close()
+        store_file = tmp.name
+        broker_args += ["--store", store_file]
+    broker = subprocess.Popen(broker_args, env=env,
+                              stdout=subprocess.DEVNULL,
+                              stderr=subprocess.DEVNULL)
+    try:
+        wait_port(port)
+        asyncio.run(setup_topology(port, persistent))
+        children = []
+        for _ in range(consumers):
+            children.append(subprocess.Popen(
+                [sys.executable, __file__, "--role", "consumer",
+                 "--port", str(port), "--auto-ack", str(int(auto_ack)),
+                 "--seconds", str(BENCH_SECONDS)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL))
+        time.sleep(0.3)
+        t0 = time.perf_counter()
+        for _ in range(producers):
+            children.append(subprocess.Popen(
+                [sys.executable, __file__, "--role", "producer",
+                 "--port", str(port), "--persistent", str(int(persistent)),
+                 "--seconds", str(BENCH_SECONDS)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL))
+        outputs = []
+        for child in children:
+            out, _ = child.communicate(timeout=BENCH_SECONDS + 60)
+            outputs.append(json.loads(out.decode().strip().splitlines()[-1]))
+        elapsed = time.perf_counter() - t0
+    finally:
+        broker.terminate()
+        broker.wait(timeout=10)
+        if store_file:
+            try:
+                os.unlink(store_file)
+            except OSError:
+                pass
+    published = sum(o.get("published", 0) for o in outputs)
+    delivered = sum(o.get("delivered", 0) for o in outputs)
+    p99s = [o["p99_us"] for o in outputs if o.get("p99_us") is not None]
+    p50s = [o["p50_us"] for o in outputs if o.get("p50_us") is not None]
+    return {
+        "published_per_s": round(published / BENCH_SECONDS, 1),
+        "delivered_per_s": round(delivered / BENCH_SECONDS, 1),
+        "published": published,
+        "delivered": delivered,
+        "p50_us": round(max(p50s), 1) if p50s else None,
+        "p99_us": round(max(p99s), 1) if p99s else None,
+        "wall_s": round(elapsed, 2),
+    }
+
+
+def main() -> None:
+    if "--role" in sys.argv:
+        import argparse
+
+        parser = argparse.ArgumentParser()
+        parser.add_argument("--role", required=True)
+        parser.add_argument("--port", type=int, required=True)
+        parser.add_argument("--auto-ack", type=int, default=1)
+        parser.add_argument("--persistent", type=int, default=0)
+        parser.add_argument("--seconds", type=float, default=5)
+        args = parser.parse_args()
+        if args.role == "producer":
+            asyncio.run(producer_main(args.port, bool(args.persistent), args.seconds))
+        else:
+            asyncio.run(consumer_main(args.port, bool(args.auto_ack), args.seconds))
+        return
+
+    which = os.environ.get("BENCH_SPECS", "a")
+    names = list(SPECS) if which == "all" else ["transient_autoack_3p3c"]
+    results = {}
+    for name in names:
+        results[name] = run_spec(name)
+        print(f"# {name}: {results[name]}", file=sys.stderr)
+    headline = results[names[0]]
+    line = {
+        "metric": "amqp_delivered_msgs_per_s_transient_autoack_3p3c",
+        "value": headline["delivered_per_s"],
+        "unit": "msgs/s",
+        "vs_baseline": None,  # reference published no numbers (BASELINE.md)
+        "p99_publish_to_deliver_us": headline["p99_us"],
+        "body_bytes": BODY_BYTES,
+        "seconds": BENCH_SECONDS,
+        "specs": results,
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

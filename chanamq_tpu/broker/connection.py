@@ -128,6 +128,9 @@ class AMQPConnection:
         self._authenticated = False
         self._tuned = False
         self._opened = False
+        # confirm coalescing: channel id -> highest publish seq completed in
+        # the current read batch; flushed as one Basic.Ack(multiple) per batch
+        self._pending_confirms: dict[int, int] = {}
 
     # ------------------------------------------------------------------
     # output path
@@ -248,6 +251,16 @@ class AMQPConnection:
                                          out.method.CLASS_ID, out.method.METHOD_ID))
                     if self.closing:
                         return
+            self._flush_confirms()
+
+    def _flush_confirms(self) -> None:
+        if not self._pending_confirms:
+            return
+        for channel_id, max_seq in self._pending_confirms.items():
+            if channel_id in self.channels:
+                self.send_method(
+                    channel_id, am.Basic.Ack(delivery_tag=max_seq, multiple=True))
+        self._pending_confirms.clear()
 
     # ------------------------------------------------------------------
     # teardown / close
@@ -256,6 +269,7 @@ class AMQPConnection:
     async def _hard_close(
         self, code: ErrorCode, text: str, class_id: int = 0, method_id: int = 0
     ) -> None:
+        self._flush_confirms()
         if not self.closing:
             self.send_method(0, am.Connection.Close(
                 reply_code=int(code), reply_text=text[:255],
@@ -266,6 +280,8 @@ class AMQPConnection:
     def _soft_close_channel(self, channel_id: int, exc: ChannelError) -> None:
         """Channel exception: close just the channel (reference behavior for
         404/405/406 soft errors)."""
+        self._flush_confirms()
+        self._pending_confirms.pop(channel_id, None)
         channel = self.channels.pop(channel_id, None)
         if channel is not None:
             channel.release_all()
@@ -421,6 +437,9 @@ class AMQPConnection:
             self._opened = True
             self.send_method(0, am.Connection.OpenOk())
         elif isinstance(method, am.Connection.Close):
+            # confirms for publishes pipelined ahead of the close must still
+            # reach the client before close-ok
+            self._flush_confirms()
             self.send_method(0, am.Connection.CloseOk())
             self.closing = True
         elif isinstance(method, am.Connection.CloseOk):
@@ -469,6 +488,8 @@ class AMQPConnection:
         elif isinstance(method, am.Channel.FlowOk):
             pass
         elif isinstance(method, am.Channel.Close):
+            self._flush_confirms()
+            self._pending_confirms.pop(cid, None)
             channel = self.channels.pop(cid, None)
             if channel is not None:
                 channel.release_all()
@@ -660,10 +681,11 @@ class AMQPConnection:
                     exchange=method.exchange, routing_key=method.routing_key),
                 props, command.body))
         if seq is not None:
-            # confirm after route+persist completed (multiple-coalescing
-            # happens naturally: the writer task batches consecutive acks
-            # into one TCP push)
-            self.send_method(channel.id, am.Basic.Ack(delivery_tag=seq, multiple=False))
+            # coalesce: publish seqs are contiguous per channel and commands
+            # are processed in order, so one Basic.Ack(multiple=true) with the
+            # batch's max seq confirms everything processed this read batch
+            # (reference: the run-length logic at FrameStage.scala:571-596)
+            self._pending_confirms[channel.id] = seq
             self.broker.metrics.confirmed_msgs += 1
 
     async def _on_consume(self, channel: ServerChannel, method: am.Basic.Consume) -> None:

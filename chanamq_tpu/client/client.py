@@ -275,6 +275,7 @@ class ClientChannel:
         self._publish_seq = 0
         self._confirm_waiters: dict[int, asyncio.Future] = {}
         self.unconfirmed: set[int] = set()
+        self._confirm_event = asyncio.Event()
 
     # -- RPC plumbing ------------------------------------------------------
 
@@ -365,6 +366,7 @@ class ClientChannel:
     def _closed_by_server(self, exc: ChannelClosedError) -> None:
         self.closed = True
         self.close_reason = exc
+        self._confirm_event.set()  # wake wait_unconfirmed_below immediately
         if self.client.channels.pop(self.id, None) is not None:
             self.client._free_channel_ids.append(self.id)
         for _, fut in self._waiters:
@@ -396,6 +398,24 @@ class ClientChannel:
                     fut.set_exception(AMQPClientError(f"publish {tag} nacked"))
                 else:
                     fut.set_result(True)
+        self._confirm_event.set()
+
+    async def wait_unconfirmed_below(self, n: int, timeout: float = 30) -> None:
+        """Block until fewer than n publishes are awaiting confirmation
+        (the PerfTest-style in-flight window)."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while len(self.unconfirmed) >= n:
+            if self.closed:
+                raise self.close_reason or ChannelClosedError(0, "closed")
+            remaining = deadline - asyncio.get_event_loop().time()
+            if remaining <= 0:
+                raise asyncio.TimeoutError(
+                    f"still {len(self.unconfirmed)} unconfirmed")
+            self._confirm_event.clear()
+            try:
+                await asyncio.wait_for(self._confirm_event.wait(), remaining)
+            except asyncio.TimeoutError:
+                continue
 
     # -- channel ops -------------------------------------------------------
 

@@ -1,0 +1,31 @@
+"""Auxiliary JAX models — analytics over broker metrics.
+
+The reference contains no ML compute path (SURVEY.md preamble: zero tensor
+code in the tree), so per SURVEY.md §7.1 the only honest JAX component is
+batch analytics over broker telemetry, strictly OFF the message path. The
+flagship model is a small causal transformer that forecasts per-queue
+traffic (enqueue/dequeue rates, depth) from a sliding window of metrics —
+the kind of capacity/backlog prediction an operator would bolt onto a broker.
+
+TPU-first by construction: bfloat16 matmuls sized for the MXU, static
+shapes, lax.scan-free forward, shardable over a (dp, tp) device mesh via
+NamedSharding annotations (see chanamq_tpu.parallel).
+"""
+
+from .forecaster import (
+    ForecasterConfig,
+    init_params,
+    forward,
+    loss_fn,
+    make_train_step,
+    synthetic_batch,
+)
+
+__all__ = [
+    "ForecasterConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "make_train_step",
+    "synthetic_batch",
+]

@@ -1,0 +1,157 @@
+"""Broker-metrics forecaster: a small causal transformer in pure JAX.
+
+Input: a window of per-tick broker telemetry vectors
+(features: enqueue rate, dequeue rate, queue depth, unacked count, consumer
+count, publish bytes, deliver bytes, confirm rate — produced by
+chanamq_tpu.utils.metrics). Output: the forecast telemetry vector for the
+next tick. Used for backlog/capacity prediction; never on the message path.
+
+Design notes (TPU):
+- all matmuls in bfloat16 with float32 accumulation (MXU native);
+- static shapes everywhere, no data-dependent control flow -> one XLA trace;
+- dims chosen as multiples of 128 lanes where it matters (d_model, d_ff);
+- params are a flat pytree of named arrays so chanamq_tpu.parallel can map
+  each leaf to a NamedSharding over a (dp, tp) mesh and let GSPMD insert the
+  collectives (the scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ForecasterConfig:
+    n_features: int = 8
+    seq_len: int = 64
+    d_model: int = 256
+    n_heads: int = 4
+    d_ff: int = 1024
+    n_layers: int = 4
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+Params = dict[str, jnp.ndarray]
+
+
+def init_params(rng: jax.Array, cfg: ForecasterConfig) -> Params:
+    """Flat {name: array} param tree (names carry layer index)."""
+    keys = iter(jax.random.split(rng, 4 + cfg.n_layers * 6))
+    scale = lambda fan_in: 1.0 / math.sqrt(fan_in)
+    p: Params = {
+        "embed/kernel": jax.random.normal(
+            next(keys), (cfg.n_features, cfg.d_model)) * scale(cfg.n_features),
+        "embed/bias": jnp.zeros((cfg.d_model,)),
+        "pos": jax.random.normal(
+            next(keys), (cfg.seq_len, cfg.d_model)) * 0.02,
+        "out/kernel": jax.random.normal(
+            next(keys), (cfg.d_model, cfg.n_features)) * scale(cfg.d_model),
+        "out/bias": jnp.zeros((cfg.n_features,)),
+    }
+    for layer in range(cfg.n_layers):
+        pre = f"layer{layer}"
+        p[f"{pre}/ln1/scale"] = jnp.ones((cfg.d_model,))
+        p[f"{pre}/ln2/scale"] = jnp.ones((cfg.d_model,))
+        p[f"{pre}/attn/qkv"] = jax.random.normal(
+            next(keys), (cfg.d_model, 3 * cfg.d_model)) * scale(cfg.d_model)
+        p[f"{pre}/attn/proj"] = jax.random.normal(
+            next(keys), (cfg.d_model, cfg.d_model)) * scale(cfg.d_model)
+        p[f"{pre}/mlp/w1"] = jax.random.normal(
+            next(keys), (cfg.d_model, cfg.d_ff)) * scale(cfg.d_model)
+        p[f"{pre}/mlp/w2"] = jax.random.normal(
+            next(keys), (cfg.d_ff, cfg.d_model)) * scale(cfg.d_ff)
+    return p
+
+
+def _layernorm(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
+
+
+def _attention(x: jnp.ndarray, qkv: jnp.ndarray, proj: jnp.ndarray,
+               cfg: ForecasterConfig) -> jnp.ndarray:
+    b, t, d = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    fused = jnp.einsum("btd,de->bte", x, qkv.astype(x.dtype))
+    q, k, v = jnp.split(fused, 3, axis=-1)
+    q = q.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / math.sqrt(hd)
+    causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+    logits = jnp.where(causal, logits, -1e30)
+    weights = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
+    return jnp.einsum("btd,de->bte", out, proj.astype(x.dtype))
+
+
+def forward(params: Params, x: jnp.ndarray, cfg: ForecasterConfig) -> jnp.ndarray:
+    """x: [batch, seq_len, n_features] float32 -> forecast [batch, n_features]."""
+    h = x.astype(cfg.dtype)
+    h = jnp.einsum("btf,fd->btd", h, params["embed/kernel"].astype(cfg.dtype))
+    h = h + params["embed/bias"].astype(cfg.dtype)
+    h = h + params["pos"].astype(cfg.dtype)[None, : x.shape[1]]
+    for layer in range(cfg.n_layers):
+        pre = f"layer{layer}"
+        a = _layernorm(h, params[f"{pre}/ln1/scale"])
+        h = h + _attention(a, params[f"{pre}/attn/qkv"],
+                           params[f"{pre}/attn/proj"], cfg)
+        m = _layernorm(h, params[f"{pre}/ln2/scale"])
+        m = jnp.einsum("btd,df->btf", m, params[f"{pre}/mlp/w1"].astype(cfg.dtype))
+        m = jax.nn.gelu(m)
+        m = jnp.einsum("btf,fd->btd", m, params[f"{pre}/mlp/w2"].astype(cfg.dtype))
+        h = h + m
+    last = h[:, -1, :].astype(jnp.float32)
+    return last @ params["out/kernel"] + params["out/bias"]
+
+
+def loss_fn(params: Params, batch: tuple[jnp.ndarray, jnp.ndarray],
+            cfg: ForecasterConfig) -> jnp.ndarray:
+    x, y = batch
+    pred = forward(params, x, cfg)
+    return jnp.mean((pred - y) ** 2)
+
+
+def make_train_step(cfg: ForecasterConfig, lr: float = 1e-3) -> Callable:
+    """SGD-with-momentum train step (pure jax, optax-free so the hot path is
+    a single fused XLA program). Returns step(params, opt_state, batch) ->
+    (params, opt_state, loss)."""
+
+    def step(params: Params, momentum: Params, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+        new_momentum = jax.tree_util.tree_map(
+            lambda m, g: 0.9 * m + g, momentum, grads)
+        new_params = jax.tree_util.tree_map(
+            lambda p, m: p - lr * m, params, new_momentum)
+        return new_params, new_momentum, loss
+
+    return step
+
+
+def init_momentum(params: Params) -> Params:
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def synthetic_batch(rng: jax.Array, cfg: ForecasterConfig, batch: int):
+    """Synthetic telemetry: noisy seasonal rates (for tests and dryruns)."""
+    t = jnp.arange(cfg.seq_len + 1, dtype=jnp.float32)
+    phase = jax.random.uniform(rng, (batch, 1, cfg.n_features)) * 2 * jnp.pi
+    freq = 0.1 + jax.random.uniform(rng, (batch, 1, cfg.n_features)) * 0.3
+    series = jnp.sin(t[None, :, None] * freq + phase) + 1.5
+    noise = jax.random.normal(rng, series.shape) * 0.05
+    series = series + noise
+    return series[:, :-1, :], series[:, -1, :]

@@ -1,0 +1,20 @@
+"""Device-mesh sharding for the auxiliary analytics models.
+
+The scaling-book recipe: pick a mesh, annotate shardings on params and data,
+jit, and let GSPMD insert the collectives. Axes: "dp" (data parallel over the
+batch) x "tp" (tensor parallel over attention heads / FFN columns).
+"""
+
+from .mesh import (
+    make_mesh,
+    param_shardings,
+    batch_sharding,
+    make_sharded_train_step,
+)
+
+__all__ = [
+    "make_mesh",
+    "param_shardings",
+    "batch_sharding",
+    "make_sharded_train_step",
+]

@@ -1,0 +1,96 @@
+"""Mesh construction and sharding rules for the forecaster.
+
+Sharding plan (2D mesh, axes ("dp", "tp")):
+- batch: P("dp") on the leading axis — pure data parallelism;
+- attention qkv kernel [d, 3d]: P(None, "tp") — heads split across tp;
+- attention proj [d, d]:        P("tp", None) — row-split, GSPMD inserts the
+  reduce-scatter/all-reduce on the output;
+- mlp w1 [d, 4d]: P(None, "tp") column-split; w2 [4d, d]: P("tp", None)
+  row-split (the classic Megatron pairing, expressed purely as shardings);
+- layernorm scales / biases / embeddings: replicated.
+
+Everything else (collective insertion, overlap) is GSPMD's job — we only
+annotate. See /opt/skills/guides/pallas_guide.md + the scaling-book recipe.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.forecaster import ForecasterConfig, Params
+
+
+def make_mesh(n_devices: Optional[int] = None, tp: Optional[int] = None) -> Mesh:
+    """Build a (dp, tp) mesh over the first n_devices devices."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = devices[:n]
+    if tp is None:
+        # widest tp that divides the device count while leaving dp >= 2,
+        # so the dryrun exercises both axes (and their collectives)
+        tp = 1
+        for cand in (4, 2):
+            if n % cand == 0 and n // cand >= 2:
+                tp = cand
+                break
+    dp = n // tp
+    mesh_devices = mesh_utils.create_device_mesh((dp, tp), devices=devices)
+    return Mesh(mesh_devices, ("dp", "tp"))
+
+
+def _spec_for(name: str) -> P:
+    if name.endswith("attn/qkv") or name.endswith("mlp/w1"):
+        return P(None, "tp")
+    if name.endswith("attn/proj") or name.endswith("mlp/w2"):
+        return P("tp", None)
+    return P()  # replicated: norms, biases, embed, pos, head
+
+
+def param_shardings(mesh: Mesh, params: Params) -> dict[str, NamedSharding]:
+    return {name: NamedSharding(mesh, _spec_for(name)) for name in params}
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("dp"))
+
+
+def make_sharded_train_step(
+    mesh: Mesh, cfg: ForecasterConfig, step_fn: Callable
+) -> Callable:
+    """jit the train step with explicit in/out shardings over the mesh."""
+    dummy = {name: None for name in _param_names(cfg)}
+    p_shard = {name: NamedSharding(mesh, _spec_for(name)) for name in dummy}
+    b_shard = (batch_sharding(mesh), batch_sharding(mesh))
+    return jax.jit(
+        step_fn,
+        in_shardings=(p_shard, p_shard, b_shard),
+        out_shardings=(p_shard, p_shard, NamedSharding(mesh, P())),
+        donate_argnums=(0, 1),
+    )
+
+
+def _param_names(cfg: ForecasterConfig) -> list[str]:
+    names = ["embed/kernel", "embed/bias", "pos", "out/kernel", "out/bias"]
+    for layer in range(cfg.n_layers):
+        pre = f"layer{layer}"
+        names += [
+            f"{pre}/ln1/scale", f"{pre}/ln2/scale",
+            f"{pre}/attn/qkv", f"{pre}/attn/proj",
+            f"{pre}/mlp/w1", f"{pre}/mlp/w2",
+        ]
+    return names
+
+
+def place(mesh: Mesh, params: Params, batch: Any):
+    """Device-put params/batch with their shardings (host -> mesh)."""
+    p_sharded = {
+        name: jax.device_put(value, NamedSharding(mesh, _spec_for(name)))
+        for name, value in params.items()
+    }
+    b_sharded = tuple(jax.device_put(part, batch_sharding(mesh)) for part in batch)
+    return p_sharded, b_sharded

@@ -91,3 +91,32 @@ async def test_client_channel_ids_are_reused(server):
 async def test_async_fixture_with_request_param(request):
     """conftest shim must pass `request` through to async fixtures/tests."""
     assert request.node.name == "test_async_fixture_with_request_param"
+
+
+async def test_confirms_flushed_before_pipelined_channel_close(client):
+    """Publishes pipelined immediately ahead of Channel.Close in one TCP
+    batch must still be confirmed before the close-ok (review regression:
+    deferred coalesced confirms were dropped on close)."""
+    ch = await client.channel()
+    await ch.confirm_select()
+    await ch.queue_declare("pc_q")
+    # one write burst: 10 publishes + channel.close, no drain between
+    for _ in range(10):
+        ch.basic_publish(b"m", routing_key="pc_q")
+    close_fut = asyncio.get_event_loop().create_task(ch.close())
+    await asyncio.wait_for(close_fut, 5)
+    # every publish was confirmed before the channel went away
+    assert ch.unconfirmed == set()
+
+
+async def test_wait_unconfirmed_wakes_on_close(server):
+    """wait_unconfirmed_below must raise promptly when the channel dies,
+    not sleep out its timeout."""
+    c = await AMQPClient.connect("127.0.0.1", server.bound_port)
+    ch = await c.channel()
+    await ch.confirm_select()
+    ch.basic_publish(b"m", exchange="missing_ex", routing_key="x")  # 404 soft error
+    t0 = asyncio.get_event_loop().time()
+    with pytest.raises((ChannelClosedError, asyncio.TimeoutError)):
+        await ch.wait_unconfirmed_below(1, timeout=10)
+    assert asyncio.get_event_loop().time() - t0 < 5  # woke early, not at timeout

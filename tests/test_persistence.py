@@ -1,0 +1,325 @@
+"""Durability and recovery tests: SQLite store + broker restart.
+
+The HA contract of the reference (README.md:47-49, recovery call stack
+SURVEY.md §3.6): durable + persistent state survives broker death and is
+recovered from the store on the next start.
+"""
+
+import asyncio
+
+import pytest
+
+from chanamq_tpu.amqp.properties import BasicProperties
+from chanamq_tpu.broker.server import BrokerServer
+from chanamq_tpu.client import AMQPClient
+from chanamq_tpu.store.api import StoredExchange, StoredMessage, StoredQueue
+from chanamq_tpu.store.sqlite import SqliteStore
+
+pytestmark = pytest.mark.asyncio
+
+PERSISTENT = BasicProperties(delivery_mode=2)
+
+
+@pytest.fixture
+def db_path(tmp_path):
+    return str(tmp_path / "broker.db")
+
+
+async def start_server(db_path):
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                       store=SqliteStore(db_path))
+    await srv.start()
+    return srv
+
+
+# ---------------------------------------------------------------------------
+# store unit tests
+# ---------------------------------------------------------------------------
+
+
+async def test_sqlite_message_roundtrip(db_path):
+    store = SqliteStore(db_path)
+    await store.open()
+    msg = StoredMessage(id=7, properties_raw=b"\x01\x02", body=b"body",
+                        exchange="ex", routing_key="rk", refer_count=2,
+                        ttl_ms=5000)
+    await store.insert_message(msg)
+    got = await store.select_message(7)
+    assert got == msg
+    await store.update_message_refer_count(7, 1)
+    assert (await store.select_message(7)).refer_count == 1
+    await store.delete_message(7)
+    assert await store.select_message(7) is None
+    await store.close()
+
+
+async def test_sqlite_queue_roundtrip(db_path):
+    store = SqliteStore(db_path)
+    await store.open()
+    q = StoredQueue(vhost="/", name="q1", durable=True, ttl_ms=1000,
+                    arguments={"x-message-ttl": 1000})
+    await store.insert_queue_meta(q)
+    await store.insert_queue_msg("/", "q1", 1, 100, 10, None)
+    await store.insert_queue_msg("/", "q1", 2, 101, 20, 9999999999999)
+    await store.insert_queue_unacks("/", "q1", [(99, 0, 5, None)])
+    got = await store.select_queue("/", "q1")
+    assert got.name == "q1"
+    assert got.ttl_ms == 1000
+    assert got.msgs == [(1, 100, 10, None), (2, 101, 20, 9999999999999)]
+    assert got.unacks == {99: (0, 5, None)}
+    # watermark advance prunes the log
+    await store.update_queue_last_consumed("/", "q1", 1)
+    got = await store.select_queue("/", "q1")
+    assert got.last_consumed == 1
+    assert got.msgs == [(2, 101, 20, 9999999999999)]
+    await store.delete_queue_unacks("/", "q1", [99])
+    assert (await store.select_queue("/", "q1")).unacks == {}
+    await store.close()
+
+
+async def test_sqlite_exchange_binds_roundtrip(db_path):
+    store = SqliteStore(db_path)
+    await store.open()
+    await store.insert_exchange(StoredExchange(
+        vhost="/", name="ex", type="topic", durable=True))
+    await store.insert_bind("/", "ex", "q1", "a.*", None)
+    await store.insert_bind("/", "ex", "q2", "a.#", {"x": 1})
+    got = await store.select_exchange("/", "ex")
+    assert got.type == "topic"
+    assert sorted(got.binds) == [("a.#", "q2", {"x": 1}), ("a.*", "q1", None)]
+    await store.delete_bind("/", "ex", "q1", "a.*")
+    assert len((await store.select_exchange("/", "ex")).binds) == 1
+    await store.delete_queue_binds("/", "q2")
+    assert (await store.select_exchange("/", "ex")).binds == []
+    await store.close()
+
+
+async def test_sqlite_archive_on_delete(db_path):
+    store = SqliteStore(db_path)
+    await store.open()
+    await store.insert_queue_meta(StoredQueue(vhost="/", name="dq", durable=True))
+    await store.insert_queue_msg("/", "dq", 1, 500, 9, None)
+    await store.archive_queue("/", "dq")
+    await store.delete_queue("/", "dq")
+    assert await store.select_queue("/", "dq") is None
+    # archival copies exist (reference: *_deleted tables)
+    def q(db):
+        rows = db.execute("SELECT * FROM queue_msgs_deleted").fetchall()
+        metas = db.execute("SELECT * FROM queue_metas_deleted").fetchall()
+        return rows, metas
+    rows, metas = await store._exec(q)
+    assert len(rows) == 1 and rows[0][3] == 500
+    assert len(metas) == 1
+    await store.close()
+
+
+# ---------------------------------------------------------------------------
+# broker restart recovery
+# ---------------------------------------------------------------------------
+
+
+async def test_durable_entities_survive_restart(db_path):
+    srv = await start_server(db_path)
+    c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    ch = await c.channel()
+    await ch.exchange_declare("dur_ex", "topic", durable=True)
+    await ch.queue_declare("dur_q", durable=True)
+    await ch.queue_bind("dur_q", "dur_ex", "logs.#")
+    for i in range(5):
+        ch.basic_publish(f"p{i}".encode(), exchange="dur_ex",
+                         routing_key="logs.app", properties=PERSISTENT)
+    await asyncio.sleep(0.1)
+    await c.close()
+    await srv.stop()
+
+    # new broker process-equivalent: fresh server over the same file
+    srv2 = await start_server(db_path)
+    try:
+        c2 = await AMQPClient.connect("127.0.0.1", srv2.bound_port)
+        ch2 = await c2.channel()
+        ok = await ch2.queue_declare("dur_q", passive=True)
+        assert ok.message_count == 5
+        # the binding also survived: publish routes again
+        ch2.basic_publish(b"p5", exchange="dur_ex", routing_key="logs.db",
+                          properties=PERSISTENT)
+        await asyncio.sleep(0.1)
+        bodies = []
+        for _ in range(6):
+            m = await ch2.basic_get("dur_q", no_ack=True)
+            bodies.append(m.body)
+        assert bodies == [b"p0", b"p1", b"p2", b"p3", b"p4", b"p5"]
+        await c2.close()
+    finally:
+        await srv2.stop()
+
+
+async def test_transient_messages_do_not_survive_restart(db_path):
+    srv = await start_server(db_path)
+    c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    ch = await c.channel()
+    await ch.queue_declare("mix_q", durable=True)
+    ch.basic_publish(b"persistent", routing_key="mix_q", properties=PERSISTENT)
+    ch.basic_publish(b"transient", routing_key="mix_q")  # delivery_mode unset
+    await asyncio.sleep(0.1)
+    await c.close()
+    await srv.stop()
+
+    srv2 = await start_server(db_path)
+    try:
+        c2 = await AMQPClient.connect("127.0.0.1", srv2.bound_port)
+        ch2 = await c2.channel()
+        ok = await ch2.queue_declare("mix_q", passive=True)
+        assert ok.message_count == 1
+        m = await ch2.basic_get("mix_q", no_ack=True)
+        assert m.body == b"persistent"
+        await c2.close()
+    finally:
+        await srv2.stop()
+
+
+async def test_unacked_messages_recovered_after_crash(db_path):
+    """Deliver without ack, kill the broker: the message must come back
+    (redeliverable) after restart — the reference's unack table reload."""
+    srv = await start_server(db_path)
+    c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    ch = await c.channel()
+    await ch.queue_declare("crash_q", durable=True)
+    got = []
+    await ch.basic_consume("crash_q", lambda m: got.append(m))  # no ack sent
+    ch.basic_publish(b"inflight", routing_key="crash_q", properties=PERSISTENT)
+    await asyncio.sleep(0.2)
+    assert len(got) == 1
+    # crash: no clean client close, no ack
+    await srv.stop()
+
+    srv2 = await start_server(db_path)
+    try:
+        c2 = await AMQPClient.connect("127.0.0.1", srv2.bound_port)
+        ch2 = await c2.channel()
+        ok = await ch2.queue_declare("crash_q", passive=True)
+        assert ok.message_count == 1
+        m = await ch2.basic_get("crash_q", no_ack=True)
+        assert m.body == b"inflight"
+        await c2.close()
+    finally:
+        await srv2.stop()
+
+
+async def test_unacked_survive_double_crash(db_path):
+    """Review regression: recovery converts unack rows back into queue-log
+    rows, so a second crash before redelivery still retains the message."""
+    srv = await start_server(db_path)
+    c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    ch = await c.channel()
+    await ch.queue_declare("dd_q", durable=True)
+    got = []
+    await ch.basic_consume("dd_q", lambda m: got.append(m))
+    ch.basic_publish(b"sticky", routing_key="dd_q", properties=PERSISTENT)
+    await asyncio.sleep(0.2)
+    await srv.stop()  # crash 1 with message unacked
+
+    srv2 = await start_server(db_path)
+    await srv2.stop()  # crash 2 before anyone consumed
+
+    srv3 = await start_server(db_path)
+    try:
+        c3 = await AMQPClient.connect("127.0.0.1", srv3.bound_port)
+        ch3 = await c3.channel()
+        m = await ch3.basic_get("dd_q", no_ack=True)
+        assert m is not None and m.body == b"sticky"
+        await c3.close()
+    finally:
+        await srv3.stop()
+
+
+async def test_acked_messages_not_recovered(db_path):
+    srv = await start_server(db_path)
+    c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    ch = await c.channel()
+    await ch.queue_declare("done_q", durable=True)
+    ch.basic_publish(b"done", routing_key="done_q", properties=PERSISTENT)
+    await asyncio.sleep(0.1)
+    m = await ch.basic_get("done_q")
+    ch.basic_ack(m.delivery_tag)
+    await asyncio.sleep(0.1)
+    await c.close()
+    await srv.stop()
+
+    srv2 = await start_server(db_path)
+    try:
+        c2 = await AMQPClient.connect("127.0.0.1", srv2.bound_port)
+        ch2 = await c2.channel()
+        ok = await ch2.queue_declare("done_q", passive=True)
+        assert ok.message_count == 0
+        await c2.close()
+    finally:
+        await srv2.stop()
+
+
+async def test_deleted_queue_not_recovered(db_path):
+    srv = await start_server(db_path)
+    c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    ch = await c.channel()
+    await ch.queue_declare("gone_q", durable=True)
+    ch.basic_publish(b"x", routing_key="gone_q", properties=PERSISTENT)
+    await asyncio.sleep(0.1)
+    await ch.queue_delete("gone_q")
+    await c.close()
+    await srv.stop()
+
+    srv2 = await start_server(db_path)
+    try:
+        c2 = await AMQPClient.connect("127.0.0.1", srv2.bound_port)
+        ch2 = await c2.channel()
+        from chanamq_tpu.client.client import ChannelClosedError
+
+        with pytest.raises(ChannelClosedError):
+            await ch2.queue_declare("gone_q", passive=True)
+        await c2.close()
+    finally:
+        await srv2.stop()
+
+
+async def test_vhosts_survive_restart(db_path):
+    srv = await start_server(db_path)
+    await srv.broker.create_vhost("tenant-a")
+    await srv.stop()
+    srv2 = await start_server(db_path)
+    try:
+        c = await AMQPClient.connect("127.0.0.1", srv2.bound_port, vhost="tenant-a")
+        ch = await c.channel()
+        ok = await ch.queue_declare("t_q")
+        assert ok.queue == "t_q"
+        await c.close()
+    finally:
+        await srv2.stop()
+
+
+async def test_message_refcount_deleted_when_all_queues_ack(db_path):
+    """A message fanned to 2 durable queues is deleted from the store only
+    after both copies are consumed (reference: MessageEntity refcount)."""
+    srv = await start_server(db_path)
+    c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    ch = await c.channel()
+    await ch.exchange_declare("fan2", "fanout", durable=True)
+    await ch.queue_declare("f_q1", durable=True)
+    await ch.queue_declare("f_q2", durable=True)
+    await ch.queue_bind("f_q1", "fan2", "")
+    await ch.queue_bind("f_q2", "fan2", "")
+    ch.basic_publish(b"shared", exchange="fan2", properties=PERSISTENT)
+    await asyncio.sleep(0.1)
+    store = srv.broker.store
+
+    m1 = await ch.basic_get("f_q1", no_ack=True)
+    assert m1.body == b"shared"
+    await asyncio.sleep(0.1)
+    msgs = await store._exec(lambda db: db.execute("SELECT id FROM msgs").fetchall())
+    assert len(msgs) == 1  # still referenced by f_q2
+
+    m2 = await ch.basic_get("f_q2", no_ack=True)
+    await asyncio.sleep(0.1)
+    msgs = await store._exec(lambda db: db.execute("SELECT id FROM msgs").fetchall())
+    assert msgs == []  # refcount hit zero -> blob deleted
+
+    await c.close()
+    await srv.stop()
