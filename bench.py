@@ -158,7 +158,7 @@ def run_spec(name: str) -> dict:
     env = {**os.environ, "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))}
     broker_args = [sys.executable, "-m", "chanamq_tpu.broker.server",
                    "--host", "127.0.0.1", "--port", str(port),
-                   "--log-level", "WARNING"]
+                   "--no-admin", "--log-level", "WARNING"]
     store_file = None
     if persistent:
         tmp = tempfile.NamedTemporaryFile(suffix=".db", delete=False)

@@ -99,28 +99,110 @@ class BrokerServer:
         finally:
             await self.stop()
 
+    @classmethod
+    def from_config(cls, config) -> "BrokerServer":
+        """Build a server (broker + listeners) from a Config tree."""
+        from ..config import Config
+
+        assert isinstance(config, Config)
+        store: Optional[StoreService] = None
+        store_path = config.get("chana.mq.store.path")
+        if store_path:
+            from ..store.sqlite import SqliteStore
+
+            store = SqliteStore(store_path)
+        ssl_context = None
+        tls_port = None
+        if config.bool("chana.mq.amqp.amqps.enabled"):
+            certfile = config.get("chana.mq.amqp.amqps.certfile")
+            keyfile = config.get("chana.mq.amqp.amqps.keyfile")
+            if not certfile:
+                from ..config import ConfigError
+
+                raise ConfigError(
+                    "chana.mq.amqp.amqps.enabled is true but "
+                    "chana.mq.amqp.amqps.certfile is not set")
+            ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_context.load_cert_chain(certfile, keyfile)
+            tls_port = config.int("chana.mq.amqp.amqps.port")
+        heartbeat = config.duration_s("chana.mq.amqp.connection.heartbeat")
+        sweep = config.duration_s("chana.mq.message.sweep-interval")
+        broker = Broker(
+            store=store,
+            message_sweep_interval_s=sweep if sweep is not None else 0.0,
+        )
+        return cls(
+            broker=broker,
+            host=config.str("chana.mq.amqp.interface"),
+            port=config.int("chana.mq.amqp.port"),
+            tls_port=tls_port,
+            ssl_context=ssl_context,
+            # sub-second configs round up to 1s rather than silently disabling
+            heartbeat_s=max(1, round(heartbeat)) if heartbeat else 0,
+            frame_max=config.size_bytes("chana.mq.amqp.connection.frame-max"),
+            channel_max=config.int("chana.mq.amqp.connection.channel-max"),
+        )
+
+
+async def run_node(config) -> None:
+    """Boot a full node: broker + AMQP(+AMQPS) listeners + admin REST
+    (the reference's AMQPServer.main composition, AMQPServer.scala:39-111)."""
+    from ..rest.admin import AdminServer
+
+    server = BrokerServer.from_config(config)
+    admin = None
+    started = False
+    try:
+        await server.start()
+        started = True
+        if config.bool("chana.mq.admin.enabled"):
+            admin = AdminServer(
+                server.broker,
+                host=config.str("chana.mq.admin.interface"),
+                port=config.int("chana.mq.admin.port"),
+            )
+            await admin.start()
+        await asyncio.Event().wait()
+    finally:
+        if admin:
+            await admin.stop()
+        if started:
+            await server.stop()
+
 
 def main() -> None:
     import argparse
 
+    from ..config import Config
+
     parser = argparse.ArgumentParser(description="chanamq-tpu AMQP broker")
-    parser.add_argument("--host", default="0.0.0.0")
-    parser.add_argument("--port", type=int, default=5672)
-    parser.add_argument("--store", default=None, help="sqlite db path (default: in-memory transient)")
+    parser.add_argument("--config", default=None, help="JSON config file")
+    parser.add_argument("--host", default=None)
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--admin-port", type=int, default=None)
+    parser.add_argument("--no-admin", action="store_true")
+    parser.add_argument("--store", default=None,
+                        help="sqlite db path (default: in-memory transient)")
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args()
     logging.basicConfig(
         level=args.log_level,
         format="%(asctime)s %(levelname)s %(name)s %(message)s")
 
-    store: Optional[StoreService] = None
-    if args.store:
-        from ..store.sqlite import SqliteStore
-
-        store = SqliteStore(args.store)
-    server = BrokerServer(host=args.host, port=args.port, store=store)
+    overrides: dict = {}
+    if args.host is not None:
+        overrides["chana.mq.amqp.interface"] = args.host
+    if args.port is not None:
+        overrides["chana.mq.amqp.port"] = args.port
+    if args.admin_port is not None:
+        overrides["chana.mq.admin.port"] = args.admin_port
+    if args.no_admin:
+        overrides["chana.mq.admin.enabled"] = False
+    if args.store is not None:
+        overrides["chana.mq.store.path"] = args.store
+    config = Config(overrides, file=args.config)
     try:
-        asyncio.run(server.serve_forever())
+        asyncio.run(run_node(config))
     except KeyboardInterrupt:
         pass
 

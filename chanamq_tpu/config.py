@@ -1,0 +1,186 @@
+"""Layered configuration tree.
+
+Capability parity with the reference's Typesafe-HOCON settings system
+(chana-mq-base Settings.scala:29-219 and the reference.conf trees,
+chana-mq-server reference.conf:107-179): a typed accessor layer over layered
+sources — built-in defaults <- config file (JSON) <- environment variables —
+keeping the reference's knob names (dotted paths under ``chana.mq``) where
+they exist, e.g.:
+
+    chana.mq.amqp.interface / port / amqps.port      (listeners)
+    chana.mq.amqp.connection.heartbeat / frame-max / channel-max
+    chana.mq.internal.timeout                        (internal op timeout)
+    chana.mq.message.inactive                        (passivation age)
+    chana.mq.admin.port                              (localhost admin REST)
+    chana.mq.vhost.separator / default
+    chana.mq.store.path                              (sqlite file; absent =
+                                                      in-memory transient)
+    chana.mq.cluster.*                               (cluster layer)
+
+Env override: dots/dashes become underscores, upper-cased, prefixed CHANAMQ_
+(e.g. CHANAMQ_AMQP_PORT=5673 overrides chana.mq.amqp.port).
+
+Durations accept int seconds or strings like "30s"/"500ms"/"infinite"
+(the reference's "infinite"-aware parser, Settings.scala:60-77); sizes accept
+int bytes or "128KiB"/"4MiB".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Mapping, Optional
+
+DEFAULTS: dict[str, Any] = {
+    "chana.mq.amqp.interface": "0.0.0.0",
+    "chana.mq.amqp.port": 5672,
+    "chana.mq.amqp.amqps.enabled": False,
+    "chana.mq.amqp.amqps.port": 5671,
+    "chana.mq.amqp.amqps.certfile": None,
+    "chana.mq.amqp.amqps.keyfile": None,
+    "chana.mq.amqp.connection.heartbeat": "30s",
+    "chana.mq.amqp.connection.frame-max": "128KiB",
+    "chana.mq.amqp.connection.channel-max": 2047,
+    "chana.mq.internal.timeout": "20s",
+    "chana.mq.message.inactive": "1h",
+    "chana.mq.message.sweep-interval": "1s",
+    "chana.mq.admin.enabled": True,
+    "chana.mq.admin.interface": "127.0.0.1",
+    "chana.mq.admin.port": 15672,
+    "chana.mq.vhost.default": "/",
+    "chana.mq.store.path": None,
+    "chana.mq.cluster.enabled": False,
+    "chana.mq.cluster.host": "127.0.0.1",
+    "chana.mq.cluster.port": 25672,
+    "chana.mq.cluster.seeds": [],
+    "chana.mq.cluster.heartbeat-interval": "1s",
+    "chana.mq.cluster.failure-timeout": "5s",
+    "chana.mq.cluster.virtual-nodes": 64,
+}
+
+_DURATION_RE = re.compile(r"^\s*([0-9.]+)\s*(ms|s|m|h|d)?\s*$")
+_DURATION_UNITS = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+_SIZE_RE = re.compile(r"^\s*([0-9.]+)\s*(B|KiB|KB|MiB|MB|GiB|GB)?\s*$", re.I)
+_SIZE_UNITS = {
+    "b": 1, "kib": 1024, "kb": 1000, "mib": 1024**2,
+    "mb": 1000**2, "gib": 1024**3, "gb": 1000**3,
+}
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def parse_duration_s(value: Any) -> Optional[float]:
+    """'30s' -> 30.0; 'infinite'/'off'/None -> None (disabled)."""
+    if value is None:
+        return None
+    if isinstance(value, (int, float)):
+        return float(value)
+    text = str(value).strip().lower()
+    if text in ("infinite", "inf", "off", "none"):
+        return None
+    match = _DURATION_RE.match(text)
+    if not match:
+        raise ConfigError(f"bad duration: {value!r}")
+    return float(match.group(1)) * _DURATION_UNITS.get(match.group(2) or "s", 1.0)
+
+
+def parse_size_bytes(value: Any) -> int:
+    if isinstance(value, (int, float)):
+        return int(value)
+    match = _SIZE_RE.match(str(value))
+    if not match:
+        raise ConfigError(f"bad size: {value!r}")
+    return int(float(match.group(1)) * _SIZE_UNITS[(match.group(2) or "B").lower()])
+
+
+def _env_key(path: str) -> str:
+    # chana.mq.amqp.frame-max -> CHANAMQ_AMQP_FRAME_MAX
+    trimmed = path[len("chana.mq."):] if path.startswith("chana.mq.") else path
+    return "CHANAMQ_" + trimmed.replace(".", "_").replace("-", "_").upper()
+
+
+def _flatten(tree: Mapping[str, Any], prefix: str = "") -> dict[str, Any]:
+    flat: dict[str, Any] = {}
+    for key, value in tree.items():
+        path = f"{prefix}.{key}" if prefix else key
+        if isinstance(value, Mapping):
+            flat.update(_flatten(value, path))
+        else:
+            flat[path] = value
+    return flat
+
+
+class Config:
+    """Layered key-value config with typed accessors."""
+
+    def __init__(
+        self,
+        overrides: Optional[Mapping[str, Any]] = None,
+        *,
+        file: Optional[str] = None,
+        env: Optional[Mapping[str, str]] = None,
+    ) -> None:
+        self._values = dict(DEFAULTS)
+        if file:
+            with open(file) as f:
+                loaded = json.load(f)
+            flat = _flatten(loaded)
+            for key, value in flat.items():
+                # accept both full paths and paths relative to chana.mq
+                full = key if key.startswith("chana.") else f"chana.mq.{key}"
+                self._values[full] = value
+        env = os.environ if env is None else env
+        for path in list(self._values):
+            env_value = env.get(_env_key(path))
+            if env_value is not None:
+                self._values[path] = _coerce(env_value, self._values[path])
+        if overrides:
+            for key, value in overrides.items():
+                full = key if key.startswith("chana.") else f"chana.mq.{key}"
+                self._values[full] = value
+
+    def get(self, path: str, default: Any = None) -> Any:
+        return self._values.get(path, default)
+
+    def str(self, path: str) -> str:
+        return str(self._values[path])
+
+    def int(self, path: str) -> int:
+        return int(self._values[path])
+
+    def bool(self, path: str) -> bool:
+        value = self._values[path]
+        if isinstance(value, str):
+            return value.strip().lower() in ("1", "true", "yes", "on")
+        return bool(value)
+
+    def duration_s(self, path: str) -> Optional[float]:
+        return parse_duration_s(self._values[path])
+
+    def size_bytes(self, path: str) -> int:
+        return parse_size_bytes(self._values[path])
+
+    def list(self, path: str) -> list:
+        value = self._values[path]
+        if isinstance(value, str):
+            return [part.strip() for part in value.split(",") if part.strip()]
+        return list(value or [])
+
+    def dump(self) -> dict[str, Any]:
+        return dict(self._values)
+
+
+def _coerce(text: str, previous: Any) -> Any:
+    if isinstance(previous, bool):
+        return text.strip().lower() in ("1", "true", "yes", "on")
+    if isinstance(previous, int) and not isinstance(previous, bool):
+        try:
+            return int(text)
+        except ValueError:
+            return text
+    if isinstance(previous, list):
+        return [part.strip() for part in text.split(",") if part.strip()]
+    return text

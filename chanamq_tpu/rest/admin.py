@@ -1,0 +1,169 @@
+"""Localhost admin REST API.
+
+Capability parity with the reference's AdminApi
+(chana-mq-server .../rest/AdminApi.scala:20-61: GET /admin/vhost/put/{v} and
+/admin/vhost/delete/{v}, bound to localhost, with access logging), extended
+with the observability endpoints the reference lacked (SURVEY.md §5):
+metrics snapshot, overview, and per-queue stats.
+
+Hand-rolled HTTP/1.1 on asyncio (no third-party web framework in the image);
+GET-only, JSON responses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional
+from urllib.parse import unquote
+
+from ..broker.broker import Broker
+
+log = logging.getLogger("chanamq.admin")
+
+
+class AdminServer:
+    def __init__(
+        self, broker: Broker, host: str = "127.0.0.1", port: int = 15672
+    ) -> None:
+        self.broker = broker
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_client, self.host, self.port)
+        log.info("admin API on http://%s:%d/admin", self.host, self.port)
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _on_client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), 10)
+            parts = request_line.decode("latin-1").split()
+            if len(parts) < 2:
+                return
+            method, path = parts[0], parts[1]
+            # drain headers
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            status, payload = await self._route(method, path)
+            body = json.dumps(payload, default=str).encode()
+            writer.write(
+                f"HTTP/1.1 {status}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + body
+            )
+            await writer.drain()
+            log.info("%s %s -> %s", method, path, status.split()[0])
+        except (asyncio.TimeoutError, ConnectionResetError):
+            pass
+        except Exception:
+            log.exception("admin request failed")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _route(self, method: str, path: str) -> tuple[str, object]:
+        if method not in ("GET", "POST"):
+            return "405 Method Not Allowed", {"error": "GET/POST only"}
+        segments = [unquote(s) for s in path.strip("/").split("/") if s]
+        if not segments or segments[0] != "admin":
+            return "404 Not Found", {"error": "unknown path"}
+        segments = segments[1:]
+        try:
+            # vhost mutations (paths mirror the reference's AdminApi, but
+            # require POST: a GET mutation is CSRF-triggerable from any web
+            # page even on localhost)
+            if len(segments) == 3 and segments[0] == "vhost" and segments[1] == "put":
+                if method != "POST":
+                    return "405 Method Not Allowed", {"error": "use POST"}
+                await self.broker.create_vhost(segments[2])
+                return "200 OK", {"ok": True, "vhost": segments[2]}
+            if len(segments) == 3 and segments[0] == "vhost" and segments[1] == "delete":
+                if method != "POST":
+                    return "405 Method Not Allowed", {"error": "use POST"}
+                deleted = await self.broker.delete_vhost(segments[2])
+                return "200 OK", {"ok": deleted, "vhost": segments[2]}
+            if method != "GET":
+                return "405 Method Not Allowed", {"error": "use GET"}
+            # observability
+            if segments == ["metrics"]:
+                return "200 OK", self.broker.metrics.snapshot()
+            if segments == ["overview"]:
+                return "200 OK", self._overview()
+            if len(segments) == 2 and segments[0] == "queues":
+                return "200 OK", self._queues(segments[1])
+            if len(segments) == 2 and segments[0] == "exchanges":
+                return "200 OK", self._exchanges(segments[1])
+        except Exception as exc:
+            return "500 Internal Server Error", {"error": str(exc)}
+        return "404 Not Found", {"error": "unknown path"}
+
+    def _overview(self) -> dict:
+        return {
+            "product": "chanamq-tpu",
+            "vhosts": {
+                name: {
+                    "active": vhost.active,
+                    "exchanges": len(vhost.exchanges),
+                    "queues": len(vhost.queues),
+                    "messages": sum(len(q.messages) for q in vhost.queues.values()),
+                    "consumers": sum(q.consumer_count for q in vhost.queues.values()),
+                }
+                for name, vhost in self.broker.vhosts.items()
+            },
+            "metrics": self.broker.metrics.snapshot(),
+        }
+
+    def _queues(self, vhost_name: str) -> list:
+        vhost = self.broker.vhosts.get(vhost_name)
+        if vhost is None:
+            return []
+        return [
+            {
+                "name": queue.name,
+                "durable": queue.durable,
+                "exclusive": queue.exclusive_owner is not None,
+                "auto_delete": queue.auto_delete,
+                "messages": queue.message_count,
+                "unacked": len(queue.outstanding),
+                "consumers": queue.consumer_count,
+                "ttl_ms": queue.ttl_ms,
+            }
+            for queue in vhost.queues.values()
+        ]
+
+    def _exchanges(self, vhost_name: str) -> list:
+        vhost = self.broker.vhosts.get(vhost_name)
+        if vhost is None:
+            return []
+        return [
+            {
+                "name": exchange.name or "(default)",
+                "type": exchange.type,
+                "durable": exchange.durable,
+                "auto_delete": exchange.auto_delete,
+                "internal": exchange.internal,
+                "bindings": len(exchange.matcher.bindings()),
+            }
+            for exchange in vhost.exchanges.values()
+        ]

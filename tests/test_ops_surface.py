@@ -1,0 +1,196 @@
+"""Ops surface tests: config tree, admin REST, TLS listener."""
+
+import asyncio
+import json
+import ssl
+import subprocess
+
+import pytest
+
+from chanamq_tpu.broker.server import BrokerServer
+from chanamq_tpu.client import AMQPClient
+from chanamq_tpu.config import Config, ConfigError, parse_duration_s, parse_size_bytes
+from chanamq_tpu.rest.admin import AdminServer
+
+pytestmark = pytest.mark.asyncio
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+def test_config_defaults():
+    cfg = Config(env={})
+    assert cfg.int("chana.mq.amqp.port") == 5672
+    assert cfg.size_bytes("chana.mq.amqp.connection.frame-max") == 128 * 1024
+    assert cfg.duration_s("chana.mq.amqp.connection.heartbeat") == 30.0
+    assert cfg.str("chana.mq.vhost.default") == "/"
+
+
+def test_config_env_override():
+    cfg = Config(env={"CHANAMQ_AMQP_PORT": "5673",
+                      "CHANAMQ_AMQP_CONNECTION_HEARTBEAT": "10s",
+                      "CHANAMQ_ADMIN_ENABLED": "false"})
+    assert cfg.int("chana.mq.amqp.port") == 5673
+    assert cfg.duration_s("chana.mq.amqp.connection.heartbeat") == 10.0
+    assert cfg.bool("chana.mq.admin.enabled") is False
+
+
+def test_config_file_layer(tmp_path):
+    f = tmp_path / "broker.json"
+    f.write_text(json.dumps({
+        "amqp": {"port": 6000, "connection": {"frame-max": "64KiB"}},
+        "chana.mq.admin.port": 16000,
+    }))
+    cfg = Config(file=str(f), env={})
+    assert cfg.int("chana.mq.amqp.port") == 6000
+    assert cfg.size_bytes("chana.mq.amqp.connection.frame-max") == 64 * 1024
+    assert cfg.int("chana.mq.admin.port") == 16000
+
+
+def test_config_overrides_win(tmp_path):
+    f = tmp_path / "c.json"
+    f.write_text(json.dumps({"amqp": {"port": 6000}}))
+    cfg = Config({"chana.mq.amqp.port": 7000}, file=str(f), env={})
+    assert cfg.int("chana.mq.amqp.port") == 7000
+
+
+def test_duration_and_size_parsing():
+    assert parse_duration_s("500ms") == 0.5
+    assert parse_duration_s("2m") == 120.0
+    assert parse_duration_s("1h") == 3600.0
+    assert parse_duration_s("infinite") is None
+    assert parse_duration_s(15) == 15.0
+    assert parse_size_bytes("4MiB") == 4 * 1024 * 1024
+    assert parse_size_bytes("1KB") == 1000
+    assert parse_size_bytes(4096) == 4096
+    with pytest.raises(ConfigError):
+        parse_duration_s("eleventy")
+
+
+# ---------------------------------------------------------------------------
+# admin REST
+# ---------------------------------------------------------------------------
+
+
+async def http_req(port: int, path: str, method: str = "GET") -> tuple[int, dict]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"{method} {path} HTTP/1.1\r\nHost: localhost\r\n\r\n".encode())
+    await writer.drain()
+    raw = await asyncio.wait_for(reader.read(65536), 5)
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = int(head.split()[1])
+    return status, json.loads(body) if body else {}
+
+
+@pytest.fixture
+async def stack():
+    server = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0)
+    await server.start()
+    admin = AdminServer(server.broker, port=0)
+    await admin.start()
+    yield server, admin
+    await admin.stop()
+    await server.stop()
+
+
+async def test_admin_vhost_put_delete(stack):
+    server, admin = stack
+    status, body = await http_req(admin.bound_port, "/admin/vhost/put/tenant1", "POST")
+    assert status == 200 and body["ok"]
+    assert "tenant1" in server.broker.vhosts
+    # AMQP clients can use it immediately
+    c = await AMQPClient.connect("127.0.0.1", server.bound_port, vhost="tenant1")
+    await c.close()
+    status, body = await http_req(admin.bound_port, "/admin/vhost/delete/tenant1", "POST")
+    assert status == 200 and body["ok"]
+    assert "tenant1" not in server.broker.vhosts
+
+
+async def test_admin_overview_and_queues(stack):
+    server, admin = stack
+    c = await AMQPClient.connect("127.0.0.1", server.bound_port)
+    ch = await c.channel()
+    await ch.queue_declare("adm_q", durable=True)
+    ch.basic_publish(b"x", routing_key="adm_q")
+    await asyncio.sleep(0.05)
+
+    status, overview = await http_req(admin.bound_port, "/admin/overview")
+    assert status == 200
+    assert overview["vhosts"]["/"]["queues"] == 1
+    assert overview["vhosts"]["/"]["messages"] == 1
+
+    status, queues = await http_req(admin.bound_port, "/admin/queues/%2F")
+    assert status == 200
+    assert queues[0]["name"] == "adm_q"
+    assert queues[0]["messages"] == 1
+    assert queues[0]["durable"] is True
+
+    status, metrics = await http_req(admin.bound_port, "/admin/metrics")
+    assert status == 200
+    assert metrics["published_msgs"] == 1
+
+    status, exchanges = await http_req(admin.bound_port, "/admin/exchanges/%2F")
+    assert status == 200
+    assert any(e["name"] == "(default)" for e in exchanges)
+    await c.close()
+
+
+async def test_admin_unknown_path_404(stack):
+    _, admin = stack
+    status, _ = await http_req(admin.bound_port, "/admin/nope")
+    assert status == 404
+    status, _ = await http_req(admin.bound_port, "/favicon.ico")
+    assert status == 404
+
+
+# ---------------------------------------------------------------------------
+# TLS (AMQPS)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    path = tmp_path_factory.mktemp("certs")
+    cert, key = str(path / "cert.pem"), str(path / "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+async def test_amqps_listener(certs):
+    certfile, keyfile = certs
+    server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server_ctx.load_cert_chain(certfile, keyfile)
+    server = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                          tls_port=0, ssl_context=server_ctx)
+    await server.start()
+    try:
+        tls_port = server._servers[1].sockets[0].getsockname()[1]
+        client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        client_ctx.check_hostname = False
+        client_ctx.verify_mode = ssl.CERT_NONE
+        c = await AMQPClient.connect("127.0.0.1", tls_port, ssl=client_ctx)
+        ch = await c.channel()
+        await ch.queue_declare("tls_q")
+        ch.basic_publish(b"over-tls", routing_key="tls_q")
+        await asyncio.sleep(0.05)
+        msg = await ch.basic_get("tls_q", no_ack=True)
+        assert msg.body == b"over-tls"
+        await c.close()
+    finally:
+        await server.stop()
+
+
+async def test_admin_mutations_require_post(stack):
+    """GET on a mutating endpoint must be rejected (CSRF hardening; the
+    reference used GET here, which is browser-triggerable)."""
+    server, admin = stack
+    status, _ = await http_req(admin.bound_port, "/admin/vhost/put/evil")
+    assert status == 405
+    assert "evil" not in server.broker.vhosts
