@@ -53,6 +53,8 @@ class Broker:
         self.idgen = IdGenerator(node_id)
         self.metrics = Metrics()
         self.vhosts: dict[str, VHost] = {}
+        # set by chanamq_tpu.cluster.node.ClusterNode when clustering is on
+        self.cluster = None
         self.message_sweep_interval_s = message_sweep_interval_s
         self._sweep_task: Optional[asyncio.Task] = None
         self._bg_tasks: set[asyncio.Task] = set()
@@ -116,51 +118,90 @@ class Broker:
             vhost = self.vhosts.get(sq.vhost)
             if vhost is None:
                 continue
-            queue = Queue(
-                self, sq.vhost, sq.name, durable=sq.durable,
-                auto_delete=sq.auto_delete, ttl_ms=sq.ttl_ms,
-                arguments=sq.arguments,
-            )
-            queue.last_consumed = sq.last_consumed
-            # pending messages + unacked (unacked become redeliverable:
-            # reference re-reads queue_unacks into the pending set on reload)
-            entries = list(sq.msgs) + [
-                (offset, msg_id, size, exp)
-                for msg_id, (offset, size, exp) in sq.unacks.items()
-            ]
-            entries.sort(key=lambda e: e[0])
-            max_offset = sq.last_consumed
-            for offset, msg_id, _size, expire_at in entries:
-                stored_msg = await self.store.select_message(msg_id)
-                if stored_msg is None:
-                    continue
-                message = self._inflate(stored_msg)
-                message.refer_count = stored_msg.refer_count
-                message.persisted = True
-                from .entities import QueuedMessage
-
-                qm = QueuedMessage(message, offset, expire_at)
-                queue.messages.append(qm)
-                max_offset = max(max_offset, offset)
-            queue.next_offset = max_offset + 1
-            if sq.unacks:
-                # Recovered unacks re-enter the queue as ready messages. They
-                # must survive a second crash, so convert the store rows:
-                # re-insert queue_msgs, rewind the persisted watermark, then
-                # drop the unack rows (FIFO store thread preserves order).
-                min_unacked = min(off for (off, _, _) in sq.unacks.values())
-                queue.last_consumed = min(sq.last_consumed, min_unacked - 1)
-                for msg_id, (offset, size, exp) in sq.unacks.items():
-                    self.store_bg(self.store.insert_queue_msg(
-                        sq.vhost, sq.name, offset, msg_id, size, exp))
-                self.store_bg(self.store.update_queue_last_consumed(
-                    sq.vhost, sq.name, queue.last_consumed))
-                self.store_bg(self.store.delete_queue_unacks(
-                    sq.vhost, sq.name, list(sq.unacks)))
-            vhost.queues[sq.name] = queue
+            vhost.queues[sq.name] = await self._load_stored_queue(sq)
         n_q = sum(len(v.queues) for v in self.vhosts.values())
         if n_q:
             log.info("recovered %d vhosts, %d queues", len(self.vhosts), n_q)
+
+    async def _load_stored_queue(self, sq: StoredQueue) -> Queue:
+        """Reconstruct one queue (pending + unacked messages) from the store
+        (reference: stash-until-Loaded preStart reload, QueueEntity.scala:107-135)."""
+        queue = Queue(
+            self, sq.vhost, sq.name, durable=sq.durable,
+            auto_delete=sq.auto_delete, ttl_ms=sq.ttl_ms,
+            arguments=sq.arguments,
+        )
+        queue.last_consumed = sq.last_consumed
+        # pending messages + unacked (unacked become redeliverable:
+        # reference re-reads queue_unacks into the pending set on reload)
+        entries = list(sq.msgs) + [
+            (offset, msg_id, size, exp)
+            for msg_id, (offset, size, exp) in sq.unacks.items()
+        ]
+        entries.sort(key=lambda e: e[0])
+        max_offset = sq.last_consumed
+        for offset, msg_id, _size, expire_at in entries:
+            stored_msg = await self.store.select_message(msg_id)
+            if stored_msg is None:
+                continue
+            message = self._inflate(stored_msg)
+            message.refer_count = stored_msg.refer_count
+            message.persisted = True
+            from .entities import QueuedMessage
+
+            qm = QueuedMessage(message, offset, expire_at)
+            queue.messages.append(qm)
+            max_offset = max(max_offset, offset)
+        queue.next_offset = max_offset + 1
+        if sq.unacks:
+            # Recovered unacks re-enter the queue as ready messages. They
+            # must survive a second crash, so convert the store rows:
+            # re-insert queue_msgs, rewind the persisted watermark, then
+            # drop the unack rows (FIFO store thread preserves order).
+            min_unacked = min(off for (off, _, _) in sq.unacks.values())
+            queue.last_consumed = min(sq.last_consumed, min_unacked - 1)
+            for msg_id, (offset, size, exp) in sq.unacks.items():
+                self.store_bg(self.store.insert_queue_msg(
+                    sq.vhost, sq.name, offset, msg_id, size, exp))
+            self.store_bg(self.store.update_queue_last_consumed(
+                sq.vhost, sq.name, queue.last_consumed))
+            self.store_bg(self.store.delete_queue_unacks(
+                sq.vhost, sq.name, list(sq.unacks)))
+        return queue
+
+    async def activate_queue(self, vhost_name: str, name: str) -> Optional[Queue]:
+        """Return the local queue, activating it from the shared store or
+        replicated metadata if needed (cluster failover: the new owner
+        materializes the queue on first touch, SURVEY.md §3.6)."""
+        vhost = self.vhosts.get(vhost_name)
+        if vhost is None:
+            return None
+        queue = vhost.queues.get(name)
+        if queue is not None:
+            return queue
+        stored = await self.store.select_queue(vhost_name, name)
+        if stored is not None:
+            queue = await self._load_stored_queue(stored)
+            # re-check: another task may have activated concurrently
+            if name in vhost.queues:
+                return vhost.queues[name]
+            vhost.queues[name] = queue
+            return queue
+        if self.cluster is not None:
+            meta = self.cluster.queue_metas.get((vhost_name, name))
+            if meta is not None:
+                # transient clustered queue: recreate the shell (contents died
+                # with the old owner, matching the reference's HA contract)
+                queue = Queue(
+                    self, vhost_name, name,
+                    durable=bool(meta.get("durable")),
+                    auto_delete=bool(meta.get("auto_delete")),
+                    ttl_ms=meta.get("ttl_ms"),
+                    arguments=dict(meta.get("arguments") or {}),
+                )
+                vhost.queues[name] = queue
+                return queue
+        return None
 
     def _inflate(self, stored: StoredMessage) -> Message:
         _, _, props = BasicProperties.decode_header(stored.properties_raw)
@@ -183,6 +224,9 @@ class Broker:
             vhost = VHost(name)
             self.vhosts[name] = vhost
             await self.store.insert_vhost(name, True)
+            if self.cluster is not None:
+                self.cluster.broadcast_bg(
+                    "meta.apply", {"kind": "vhost.created", "vhost": name})
         return vhost
 
     async def delete_vhost(self, name: str) -> bool:
@@ -192,6 +236,9 @@ class Broker:
         for queue in list(vhost.queues.values()):
             queue.deleted = True
         await self.store.delete_vhost(name)
+        if self.cluster is not None:
+            self.cluster.broadcast_bg(
+                "meta.apply", {"kind": "vhost.deleted", "vhost": name})
         return True
 
     # -- exchanges ---------------------------------------------------------
@@ -233,6 +280,12 @@ class Broker:
                 auto_delete=auto_delete, internal=internal,
                 arguments=arguments or {},
             ))
+        if self.cluster is not None:
+            self.cluster.broadcast_bg("meta.apply", {
+                "kind": "exchange.declared", "vhost": vhost_name, "name": name,
+                "type": ex_type, "durable": durable,
+                "auto_delete": auto_delete, "internal": internal, "binds": [],
+            })
         return exchange
 
     async def delete_exchange(
@@ -250,6 +303,9 @@ class Broker:
         del vhost.exchanges[name]
         if exchange.durable:
             await self.store.delete_exchange(vhost_name, name)
+        if self.cluster is not None:
+            self.cluster.broadcast_bg("meta.apply", {
+                "kind": "exchange.deleted", "vhost": vhost_name, "name": name})
 
     # -- queues ------------------------------------------------------------
 
@@ -261,6 +317,12 @@ class Broker:
     ) -> Queue:
         vhost = self.vhost(vhost_name)
         existing = vhost.queues.get(name)
+        if (existing is None and self.cluster is not None
+                and exclusive_owner is None
+                and (vhost_name, name) in self.cluster.queue_metas
+                and self.cluster.owns_queue(vhost_name, name)):
+            # owned here but not yet materialized (failover / lazy activation)
+            existing = await self.activate_queue(vhost_name, name)
         if passive:
             if existing is None:
                 raise BrokerError(ErrorCode.NOT_FOUND, f"no queue '{name}'")
@@ -289,6 +351,13 @@ class Broker:
                 exclusive=False, auto_delete=auto_delete, ttl_ms=ttl_ms,
                 last_consumed=0, arguments=arguments,
             ))
+        if self.cluster is not None and exclusive_owner is None:
+            self.cluster._register_meta(queue)
+            self.cluster.broadcast_bg("meta.apply", {
+                "kind": "queue.declared", "vhost": vhost_name, "name": name,
+                "durable": durable, "auto_delete": auto_delete,
+                "ttl_ms": ttl_ms, "arguments": arguments,
+            })
         return queue
 
     def _check_exclusive(self, queue: Queue, connection_id: Optional[int]) -> None:
@@ -307,13 +376,46 @@ class Broker:
         self._check_exclusive(queue, connection_id)
         return queue
 
+    def queue_site(
+        self, vhost_name: str, name: str, connection_id: Optional[int] = None
+    ) -> tuple[str, Optional[Queue]]:
+        """Locate a queue: ("local", queue) | ("activate", None) — owned here
+        but not yet materialized | ("remote", None) | ("none", None)."""
+        vhost = self.vhost(vhost_name)
+        queue = vhost.queues.get(name)
+        if queue is not None:
+            self._check_exclusive(queue, connection_id)
+            return ("local", queue)
+        if self.cluster is not None and (vhost_name, name) in self.cluster.queue_metas:
+            if self.cluster.owns_queue(vhost_name, name):
+                return ("activate", None)
+            return ("remote", None)
+        return ("none", None)
+
+    def _queue_is_durable(self, vhost_name: str, name: str) -> bool:
+        vhost = self.vhosts.get(vhost_name)
+        if vhost is not None and name in vhost.queues:
+            return vhost.queues[name].durable
+        if self.cluster is not None:
+            meta = self.cluster.queue_metas.get((vhost_name, name))
+            if meta is not None:
+                return bool(meta.get("durable"))
+        return False
+
+    def _require_queue_exists(
+        self, vhost_name: str, name: str, connection_id: Optional[int]
+    ) -> None:
+        site, _ = self.queue_site(vhost_name, name, connection_id)
+        if site == "none":
+            raise BrokerError(ErrorCode.NOT_FOUND, f"no queue '{name}'")
+
     async def bind_queue(
         self, vhost_name: str, queue_name: str, exchange_name: str,
         routing_key: str, arguments: Optional[dict] = None,
         connection_id: Optional[int] = None,
     ) -> None:
         vhost = self.vhost(vhost_name)
-        queue = self.get_queue(vhost_name, queue_name, connection_id)
+        self._require_queue_exists(vhost_name, queue_name, connection_id)
         exchange = vhost.exchanges.get(exchange_name)
         if exchange is None:
             raise BrokerError(ErrorCode.NOT_FOUND, f"no exchange '{exchange_name}'")
@@ -321,9 +423,15 @@ class Broker:
             raise BrokerError(
                 ErrorCode.ACCESS_REFUSED, "cannot bind to the default exchange")
         added = exchange.matcher.bind(routing_key, queue_name, arguments)
-        if added and exchange.durable and queue.durable:
+        if added and exchange.durable and self._queue_is_durable(vhost_name, queue_name):
             await self.store.insert_bind(
                 vhost_name, exchange_name, queue_name, routing_key, arguments)
+        if added and self.cluster is not None:
+            self.cluster.broadcast_bg("meta.apply", {
+                "kind": "bind.added", "vhost": vhost_name,
+                "exchange": exchange_name, "queue": queue_name,
+                "key": routing_key, "args": arguments,
+            })
 
     async def unbind_queue(
         self, vhost_name: str, queue_name: str, exchange_name: str,
@@ -331,7 +439,7 @@ class Broker:
         connection_id: Optional[int] = None,
     ) -> None:
         vhost = self.vhost(vhost_name)
-        self.get_queue(vhost_name, queue_name, connection_id)
+        self._require_queue_exists(vhost_name, queue_name, connection_id)
         exchange = vhost.exchanges.get(exchange_name)
         if exchange is None:
             raise BrokerError(ErrorCode.NOT_FOUND, f"no exchange '{exchange_name}'")
@@ -339,6 +447,12 @@ class Broker:
         if removed and exchange.durable:
             await self.store.delete_bind(
                 vhost_name, exchange_name, queue_name, routing_key)
+        if removed and self.cluster is not None:
+            self.cluster.broadcast_bg("meta.apply", {
+                "kind": "bind.removed", "vhost": vhost_name,
+                "exchange": exchange_name, "queue": queue_name,
+                "key": routing_key, "args": arguments,
+            })
         if removed and exchange.auto_delete and exchange.matcher.is_empty():
             await self.delete_exchange(vhost_name, exchange_name)
 
@@ -349,6 +463,13 @@ class Broker:
     ) -> int:
         vhost = self.vhost(vhost_name)
         queue = vhost.queues.get(name)
+        if queue is None and self.cluster is not None \
+                and (vhost_name, name) in self.cluster.queue_metas:
+            if self.cluster.owns_queue(vhost_name, name):
+                queue = await self.activate_queue(vhost_name, name)
+            else:
+                return await self.cluster.remote_delete(
+                    vhost_name, name, if_unused=if_unused, if_empty=if_empty)
         if queue is None:
             return 0
         self._check_exclusive(queue, connection_id)
@@ -370,7 +491,7 @@ class Broker:
                 if exchange.durable:
                     await self.store.delete_exchange(vhost.name, exchange.name)
         for consumer in list(queue.consumers):
-            consumer.channel.consumers.pop(consumer.tag, None)
+            consumer.detach()
             queue.consumers.remove(consumer)
         for qm in queue.messages:
             self.unrefer(qm.message)
@@ -379,11 +500,12 @@ class Broker:
             await self.store.archive_queue(vhost.name, queue.name)
             await self.store.delete_queue(vhost.name, queue.name)
             await self.store.delete_queue_binds(vhost.name, queue.name)
-        if self._cluster_publish is not None:
-            self._cluster_publish("queue.deleted", vhost.name, queue.name)
+        if self.cluster is not None and queue.exclusive_owner is None:
+            # the reference's QueueDeleted pub-sub broadcast
+            self.cluster.queue_metas.pop((vhost.name, queue.name), None)
+            self.cluster.broadcast_bg("meta.apply", {
+                "kind": "queue.deleted", "vhost": vhost.name, "name": queue.name})
         return count
-
-    _cluster_publish = None  # hook for the cluster pub-sub layer
 
     def schedule_queue_delete(self, vhost_name: str, queue_name: str) -> None:
         """Auto-delete path from sync contexts (consumer cancel)."""
@@ -424,10 +546,22 @@ class Broker:
         if exchange.internal:
             raise BrokerError(
                 ErrorCode.ACCESS_REFUSED, f"exchange '{exchange_name}' is internal")
-        queue_names = vhost.route(exchange_name, routing_key, properties.headers)
-        assert queue_names is not None
-        queues = [vhost.queues[qn] for qn in queue_names if qn in vhost.queues]
+        if exchange_name == "":
+            # default exchange: implicit binding by queue name; a clustered
+            # queue may exist only as replicated metadata on this node
+            exists = routing_key in vhost.queues or (
+                self.cluster is not None
+                and (vhost_name, routing_key) in self.cluster.queue_metas)
+            queue_names = {routing_key} if exists else set()
+        else:
+            queue_names = vhost.route(exchange_name, routing_key, properties.headers)
+            assert queue_names is not None
         self.metrics.published(len(body))
+        if self.cluster is not None:
+            return await self._publish_clustered(
+                vhost, exchange_name, routing_key, properties, body,
+                queue_names, mandatory=mandatory, immediate=immediate)
+        queues = [vhost.queues[qn] for qn in queue_names if qn in vhost.queues]
         if not queues:
             return (False, True)
         message = Message(
@@ -456,6 +590,82 @@ class Broker:
                 return (True, False)
         for queue in queues:
             queue.push(message)
+        return (True, True)
+
+    async def _publish_clustered(
+        self, vhost: VHost, exchange_name: str, routing_key: str,
+        properties: BasicProperties, body: bytes, queue_names: set[str],
+        *, mandatory: bool, immediate: bool,
+    ) -> tuple[bool, bool]:
+        """Cluster publish: routing already happened locally on the
+        replicated exchange metadata; per-owner queue.push RPCs carry the
+        message to remote queue owners (the reference's ExchangeEntity ->
+        QueueEntity ask path, ExchangeEntity.scala:287-331, with one hop
+        instead of two)."""
+        assert self.cluster is not None
+        local: list[Queue] = []
+        by_owner: dict[str, list[str]] = {}
+        for name in queue_names:
+            queue = vhost.queues.get(name)
+            if queue is not None:
+                local.append(queue)
+                continue
+            if (vhost.name, name) not in self.cluster.queue_metas:
+                continue
+            if self.cluster.owns_queue(vhost.name, name):
+                activated = await self.activate_queue(vhost.name, name)
+                if activated is not None:
+                    local.append(activated)
+            else:
+                owner = self.cluster.queue_owner(vhost.name, name)
+                by_owner.setdefault(owner, []).append(name)
+        if not local and not by_owner:
+            return (False, True)
+        props_raw = properties.encode_header(len(body))
+        had_consumer = any(
+            any(c.can_take(len(body)) for c in q.consumers) for q in local
+        )
+        if immediate:
+            # immediate is all-or-none like the single-node path: probe every
+            # owner first (no enqueue), then either push everywhere or nowhere
+            for owner, names in by_owner.items():
+                try:
+                    _, owner_had = await self.cluster.remote_push(
+                        owner, vhost.name, names, props_raw, body,
+                        exchange_name, routing_key, check_consumers=True,
+                        check_only=True)
+                    had_consumer = had_consumer or owner_had
+                except Exception as exc:
+                    log.warning("remote consumer probe to %s failed: %r", owner, exc)
+            if not had_consumer:
+                return (True, False)
+        pushed_remote = False
+        for owner, names in by_owner.items():
+            try:
+                pushed, owner_had_consumer = await self.cluster.remote_push(
+                    owner, vhost.name, names, props_raw, body,
+                    exchange_name, routing_key, check_consumers=False)
+                pushed_remote = pushed_remote or pushed
+                had_consumer = had_consumer or owner_had_consumer
+            except Exception as exc:
+                log.warning("remote push to %s failed: %r", owner, exc)
+        if not local and not pushed_remote:
+            # every target was remote and none accepted: unroutable in effect
+            return (False, True)
+        if local:
+            message = Message(
+                self.idgen.next_id(), properties, body, exchange_name,
+                routing_key, properties.expiration_ms())
+            message.refer_count = len(local)
+            persist = message.is_persistent and any(q.durable for q in local)
+            if persist:
+                message.persisted = True
+                await self.store.insert_message(StoredMessage(
+                    id=message.id, properties_raw=props_raw, body=body,
+                    exchange=exchange_name, routing_key=routing_key,
+                    refer_count=len(local), ttl_ms=message.ttl_ms))
+            for queue in local:
+                queue.push(message)
         return (True, True)
 
     # -- message refcounting (reference: MessageEntity.scala:134-166) ------

@@ -55,6 +55,15 @@ class Consumer:
         self.unacked_count = 0
         self.unacked_size = 0
 
+    def deliver(self, queue: Queue, qm: QueuedMessage) -> Optional[Delivery]:
+        """Dispatch hook: render to this consumer's channel. The cluster
+        layer's RemoteConsumer overrides this to ship over RPC instead."""
+        return self.channel.deliver(self, queue, qm)
+
+    def detach(self) -> None:
+        """Called when the queue is deleted under this consumer."""
+        self.channel.consumers.pop(self.tag, None)
+
     def can_take(self, next_size: int) -> bool:
         """Prefetch/QoS admission (reference: FrameStage.scala:387-392 +
         QueueEntity.scala:342-359): no_ack consumers are unbounded; otherwise

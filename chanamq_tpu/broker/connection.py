@@ -551,6 +551,30 @@ class AMQPConnection:
             if not name:
                 name = f"tmp.{uuid.uuid4()}"
             self.broker_check_name(name, method)
+            cluster = self.broker.cluster
+            vhost_obj = self.broker.vhost(self.vhost_name)
+            if (cluster is not None and not method.exclusive
+                    and name not in vhost_obj.queues  # local (e.g. exclusive) wins
+                    and not cluster.owns_queue(self.vhost_name, name)):
+                # clustered queue owned elsewhere: proxy to the owner
+                if method.passive:
+                    if (self.vhost_name, name) not in cluster.queue_metas:
+                        raise ChannelError(
+                            ErrorCode.NOT_FOUND, f"no queue '{name}'",
+                            method.CLASS_ID, method.METHOD_ID)
+                    counts = await cluster.remote_stats(self.vhost_name, name)
+                else:
+                    reply = await cluster.remote_declare(
+                        self.vhost_name, name,
+                        durable=method.durable, auto_delete=method.auto_delete,
+                        arguments=method.arguments)
+                    counts = (int(reply["message_count"]),
+                              int(reply["consumer_count"]))
+                if not method.nowait:
+                    self.send_method(cid, am.Queue.DeclareOk(
+                        queue=name, message_count=counts[0],
+                        consumer_count=counts[1]))
+                return
             queue = await self.broker.declare_queue(
                 self.vhost_name, name,
                 passive=method.passive, durable=method.durable,
@@ -578,8 +602,21 @@ class AMQPConnection:
                 method.routing_key, method.arguments, connection_id=self.id)
             self.send_method(cid, am.Queue.UnbindOk())
         elif isinstance(method, am.Queue.Purge):
-            queue = self.broker.get_queue(self.vhost_name, method.queue, self.id)
-            count = queue.purge()
+            site, queue = self.broker.queue_site(
+                self.vhost_name, method.queue, self.id)
+            if site == "local":
+                count = queue.purge()
+            elif site == "activate":
+                activated = await self.broker.activate_queue(
+                    self.vhost_name, method.queue)
+                count = activated.purge() if activated else 0
+            elif site == "remote":
+                count = await self.broker.cluster.remote_purge(
+                    self.vhost_name, method.queue)
+            else:
+                raise ChannelError(
+                    ErrorCode.NOT_FOUND, f"no queue '{method.queue}'",
+                    method.CLASS_ID, method.METHOD_ID)
             if not method.nowait:
                 self.send_method(cid, am.Queue.PurgeOk(message_count=count))
         elif isinstance(method, am.Queue.Delete):
@@ -611,15 +648,21 @@ class AMQPConnection:
         elif isinstance(method, am.Basic.Cancel):
             consumer = channel.consumers.pop(method.consumer_tag, None)
             if consumer is not None:
-                auto_deleted = consumer.queue.remove_consumer(consumer)
-                if auto_deleted:
-                    self.broker.schedule_queue_delete(
-                        self.vhost_name, consumer.queue.name)
+                from ..cluster.node import RemoteQueueRef
+
+                if isinstance(consumer.queue, RemoteQueueRef):
+                    await self.broker.cluster.remote_cancel(
+                        consumer.queue.vhost, consumer.queue.name, consumer.tag)
+                else:
+                    auto_deleted = consumer.queue.remove_consumer(consumer)
+                    if auto_deleted:
+                        self.broker.schedule_queue_delete(
+                            self.vhost_name, consumer.queue.name)
             if not method.nowait:
                 self.send_method(cid, am.Basic.CancelOk(
                     consumer_tag=method.consumer_tag))
         elif isinstance(method, am.Basic.Get):
-            self._on_get(channel, method)
+            await self._on_get(channel, method)
         elif isinstance(method, am.Basic.Ack):
             deliveries = channel.resolve_tags(method.delivery_tag, method.multiple)
             if not deliveries and not method.multiple:
@@ -689,11 +732,34 @@ class AMQPConnection:
             self.broker.metrics.confirmed_msgs += 1
 
     async def _on_consume(self, channel: ServerChannel, method: am.Basic.Consume) -> None:
-        queue = self.broker.get_queue(self.vhost_name, method.queue, self.id)
         tag = method.consumer_tag or f"ctag-{self.id}-{channel.id}-{len(channel.consumers) + 1}"
         if tag in channel.consumers:
             raise ChannelError(
                 ErrorCode.NOT_ALLOWED, f"consumer tag '{tag}' in use",
+                method.CLASS_ID, method.METHOD_ID)
+        site, queue = self.broker.queue_site(self.vhost_name, method.queue, self.id)
+        if site == "activate":
+            queue = await self.broker.activate_queue(self.vhost_name, method.queue)
+            site = "local" if queue is not None else "none"
+        if site == "remote":
+            if method.exclusive:
+                raise ChannelError(
+                    ErrorCode.NOT_IMPLEMENTED,
+                    "exclusive consumers on remotely-owned queues",
+                    method.CLASS_ID, method.METHOD_ID)
+            credit = channel.prefetch_count_consumer or channel.prefetch_count_global or 0
+            from ..cluster.node import DEFAULT_CREDIT
+
+            credit = min(credit, DEFAULT_CREDIT) if credit else DEFAULT_CREDIT
+            await self.broker.cluster.remote_consume(
+                channel, self.vhost_name, method.queue, tag,
+                method.no_ack, credit)
+            if not method.nowait:
+                self.send_method(channel.id, am.Basic.ConsumeOk(consumer_tag=tag))
+            return
+        if site == "none":
+            raise ChannelError(
+                ErrorCode.NOT_FOUND, f"no queue '{method.queue}'",
                 method.CLASS_ID, method.METHOD_ID)
         if queue.has_exclusive_consumer() or (method.exclusive and queue.consumers):
             raise ChannelError(
@@ -707,8 +773,18 @@ class AMQPConnection:
             self.send_method(channel.id, am.Basic.ConsumeOk(consumer_tag=tag))
         queue.add_consumer(consumer)
 
-    def _on_get(self, channel: ServerChannel, method: am.Basic.Get) -> None:
-        queue = self.broker.get_queue(self.vhost_name, method.queue, self.id)
+    async def _on_get(self, channel: ServerChannel, method: am.Basic.Get) -> None:
+        site, queue = self.broker.queue_site(self.vhost_name, method.queue, self.id)
+        if site == "activate":
+            queue = await self.broker.activate_queue(self.vhost_name, method.queue)
+            site = "local" if queue is not None else "none"
+        if site == "remote":
+            await self._on_get_remote(channel, method)
+            return
+        if site == "none":
+            raise ChannelError(
+                ErrorCode.NOT_FOUND, f"no queue '{method.queue}'",
+                method.CLASS_ID, method.METHOD_ID)
         qm = queue.basic_get()
         if qm is None:
             self.send_method(channel.id, am.Basic.GetEmpty())
@@ -737,6 +813,36 @@ class AMQPConnection:
                 self.broker.store_bg(self.broker.store.insert_queue_unacks(
                     queue.vhost, queue.name,
                     [(msg.id, qm.offset, len(msg.body), qm.expire_at_ms)]))
+
+    async def _on_get_remote(self, channel: ServerChannel, method: am.Basic.Get) -> None:
+        """basic.get on a remotely-owned queue: fetch one message over RPC
+        and account for it locally like any other unacked delivery."""
+        from ..cluster.node import RemoteQueueRef
+        from .entities import Delivery, Message, QueuedMessage
+
+        reply = await self.broker.cluster.remote_get(
+            self.vhost_name, method.queue, method.no_ack)
+        if reply.get("empty"):
+            self.send_method(channel.id, am.Basic.GetEmpty())
+            return
+        _, _, props = BasicProperties.decode_header(bytes(reply["props_raw"]))
+        message = Message(
+            int(reply["msg_id"]), props, bytes(reply["body"]),
+            str(reply["exchange"]), str(reply["routing_key"]))
+        qm = QueuedMessage(message, int(reply["offset"]), reply.get("expire_at_ms"))
+        qm.redelivered = bool(reply.get("redelivered"))
+        tag = channel.next_delivery_tag()
+        self.send_command(AMQCommand(
+            channel.id,
+            am.Basic.GetOk(
+                delivery_tag=tag, redelivered=qm.redelivered,
+                exchange=message.exchange, routing_key=message.routing_key,
+                message_count=int(reply.get("message_count", 0))),
+            message.properties, message.body))
+        self.broker.metrics.delivered(len(message.body))
+        if not method.no_ack:
+            ref = RemoteQueueRef(self.broker.cluster, self.vhost_name, method.queue)
+            channel.unacked[tag] = Delivery(qm, ref, channel, "", tag, no_ack=False)  # type: ignore[arg-type]
 
     def _on_recover(self, channel: ServerChannel, requeue: bool) -> None:
         """reference: FrameStage.scala:711-776."""

@@ -234,7 +234,7 @@ class Queue:
             qm = self.pop()
             if qm is None:
                 break
-            delivery = consumer.channel.deliver(consumer, self, qm)
+            delivery = consumer.deliver(self, qm)
             self._advance_watermark(qm)
             if delivery is None:  # no_ack: consumed immediately
                 self.broker.unrefer(qm.message)

@@ -151,10 +151,26 @@ async def run_node(config) -> None:
 
     server = BrokerServer.from_config(config)
     admin = None
+    cluster = None
     started = False
     try:
         await server.start()
         started = True
+        if config.bool("chana.mq.cluster.enabled"):
+            from ..cluster.node import ClusterNode
+
+            cluster = ClusterNode(
+                server.broker,
+                host=config.str("chana.mq.cluster.host"),
+                port=config.int("chana.mq.cluster.port"),
+                seeds=config.list("chana.mq.cluster.seeds"),
+                virtual_nodes=config.int("chana.mq.cluster.virtual-nodes"),
+                heartbeat_interval_s=config.duration_s(
+                    "chana.mq.cluster.heartbeat-interval") or 1.0,
+                failure_timeout_s=config.duration_s(
+                    "chana.mq.cluster.failure-timeout") or 5.0,
+            )
+            await cluster.start()
         if config.bool("chana.mq.admin.enabled"):
             admin = AdminServer(
                 server.broker,
@@ -166,6 +182,8 @@ async def run_node(config) -> None:
     finally:
         if admin:
             await admin.stop()
+        if cluster:
+            await cluster.stop()
         if started:
             await server.stop()
 

@@ -1,0 +1,206 @@
+"""Heartbeat membership with gossip piggyback.
+
+The analogue of the reference's Akka cluster membership + phi-accrual failure
+detection (chana-mq-base reference.conf:26-48): every node heartbeats every
+alive peer on an interval; a peer silent past the failure timeout is marked
+DOWN and leaves the ownership ring; heartbeats piggyback the sender's member
+list (with incarnation counters) so views converge without a coordinator.
+A downed node that comes back re-joins with a higher incarnation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+from .rpc import RpcClient, RpcError, RpcServer
+
+log = logging.getLogger("chanamq.membership")
+
+ALIVE = "alive"
+DOWN = "down"
+
+
+@dataclass
+class Member:
+    name: str  # "host:port" of the node's RPC endpoint
+    incarnation: int = 0
+    status: str = ALIVE
+    last_seen: float = field(default_factory=time.monotonic)
+
+    @property
+    def host(self) -> str:
+        return self.name.rsplit(":", 1)[0]
+
+    @property
+    def port(self) -> int:
+        return int(self.name.rsplit(":", 1)[1])
+
+
+MembershipListener = Callable[[str, Member], None]  # (event, member)
+
+
+class Membership:
+    """Tracks the member set for one node."""
+
+    def __init__(
+        self,
+        self_name: str,
+        seeds: list[str],
+        rpc_server: RpcServer,
+        *,
+        heartbeat_interval_s: float = 1.0,
+        failure_timeout_s: float = 5.0,
+    ) -> None:
+        self.self_name = self_name
+        self.seeds = [s for s in seeds if s != self_name]
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.failure_timeout_s = failure_timeout_s
+        self.incarnation = int(time.time() * 1000)
+        self.members: dict[str, Member] = {
+            self_name: Member(self_name, self.incarnation)
+        }
+        self.listeners: list[MembershipListener] = []
+        self._clients: dict[str, RpcClient] = {}
+        self._task: Optional[asyncio.Task] = None
+        rpc_server.register("cluster.ping", self._on_ping)
+
+    # -- view --------------------------------------------------------------
+
+    def alive_members(self) -> list[str]:
+        return sorted(
+            name for name, m in self.members.items() if m.status == ALIVE
+        )
+
+    def is_alive(self, name: str) -> bool:
+        member = self.members.get(name)
+        return member is not None and member.status == ALIVE
+
+    def leader(self) -> str:
+        """Deterministic leader: lowest alive name (the reference's
+        cluster-singleton placement on the oldest node, approximated)."""
+        alive = self.alive_members()
+        return alive[0] if alive else self.self_name
+
+    def client(self, name: str) -> RpcClient:
+        client = self._clients.get(name)
+        if client is None or client.closed:
+            member = self.members.get(name)
+            host, port = (member.host, member.port) if member else (
+                name.rsplit(":", 1)[0], int(name.rsplit(":", 1)[1]))
+            client = RpcClient(host, port)
+            self._clients[name] = client
+        return client
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        for seed in self.seeds:
+            self.members.setdefault(seed, Member(seed, 0))
+        self._task = asyncio.get_event_loop().create_task(self._heartbeat_loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+        for client in self._clients.values():
+            await client.close()
+        self._clients.clear()
+
+    # -- gossip ------------------------------------------------------------
+
+    def _view(self) -> dict:
+        return {
+            "from": self.self_name,
+            "members": {
+                name: {"incarnation": m.incarnation, "status": m.status}
+                for name, m in self.members.items()
+            },
+        }
+
+    def _merge(self, view: dict) -> None:
+        for name, info in (view.get("members") or {}).items():
+            incarnation = int(info.get("incarnation", 0))
+            status = str(info.get("status", ALIVE))
+            if name == self.self_name:
+                continue
+            member = self.members.get(name)
+            if member is None:
+                member = Member(name, incarnation, status)
+                member.last_seen = time.monotonic() if status == ALIVE else 0.0
+                self.members[name] = member
+                if status == ALIVE:
+                    self._emit("up", member)
+                continue
+            if incarnation > member.incarnation:
+                member.incarnation = incarnation
+                if status == ALIVE and member.status != ALIVE:
+                    member.status = ALIVE
+                    member.last_seen = time.monotonic()
+                    self._emit("up", member)
+                elif status == DOWN and member.status != DOWN:
+                    member.status = DOWN
+                    self._emit("down", member)
+
+    async def _on_ping(self, payload: dict) -> dict:
+        sender = str(payload.get("from", ""))
+        if sender and sender != self.self_name:
+            member = self.members.get(sender)
+            if member is None:
+                member = Member(sender)
+                self.members[sender] = member
+                self._emit("up", member)
+            elif member.status != ALIVE:
+                member.status = ALIVE
+                member.incarnation = max(
+                    member.incarnation,
+                    int((payload.get("members") or {})
+                        .get(sender, {}).get("incarnation", 0)))
+                self._emit("up", member)
+            member.last_seen = time.monotonic()
+        self._merge(payload)
+        return self._view()
+
+    async def _ping_peer(self, name: str) -> None:
+        member = self.members[name]
+        try:
+            reply = await self.client(name).call(
+                "cluster.ping", self._view(),
+                timeout_s=self.failure_timeout_s / 2)
+            member.last_seen = time.monotonic()
+            if member.status != ALIVE:
+                member.status = ALIVE
+                self._emit("up", member)
+            self._merge(reply)
+        except (RpcError, OSError, asyncio.TimeoutError):
+            if (member.status == ALIVE
+                    and time.monotonic() - member.last_seen > self.failure_timeout_s):
+                member.status = DOWN
+                member.incarnation += 1
+                log.warning("%s: marking %s DOWN", self.self_name, name)
+                self._emit("down", member)
+
+    async def _heartbeat_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.heartbeat_interval_s)
+                peers = [n for n in self.members if n != self.self_name]
+                # concurrent pings: a dead peer's timeout must not delay
+                # detection (or gossip) for the others
+                if peers:
+                    await asyncio.gather(
+                        *(self._ping_peer(name) for name in peers),
+                        return_exceptions=True)
+        except asyncio.CancelledError:
+            pass
+
+    def _emit(self, event: str, member: Member) -> None:
+        log.info("%s: member %s %s", self.self_name, member.name, event)
+        for listener in self.listeners:
+            try:
+                listener(event, member)
+            except Exception:
+                log.exception("membership listener failed")

@@ -1,0 +1,837 @@
+"""Cluster node: location-transparent broker entities over the host mesh.
+
+The rebuild of the reference's Akka-cluster distribution (SURVEY.md §5
+"distributed communication backend", §3.6 failover):
+
+- **Exchanges, bindings, vhosts are replicated** to every node (broadcast on
+  mutation + snapshot pull on join), so publish routing is always local —
+  where the reference paid a cluster `ask` per publish to a sharded
+  ExchangeEntity (ExchangeEntity.scala:287-331), here only the per-queue
+  pushes leave the node.
+- **Queues are sharded** by consistent hash over alive members (the analogue
+  of shard-id % 100 placement, QueueEntity.scala:43-51). Queue ops arriving
+  on a non-owner node are proxied over RPC. Exclusive queues stay pinned to
+  the connection's node and are never clustered.
+- **Remote consumers** stream deliveries owner -> origin with a credit
+  window (the QoS budget the reference computed per Pull,
+  FrameStage.scala:387-392, becomes an explicit credit grant on ack).
+- **Failover** (reference §3.6): node dies -> membership marks DOWN -> ring
+  excludes it -> next op (or consumer re-registration) activates the queue
+  on its new owner, which reloads durable state from the shared store.
+  Transient queue contents die with their node, matching the reference's HA
+  contract (README.md:47-49).
+- **Cluster-wide worker ids** for snowflake message ids are leased from the
+  current leader (lowest alive member - the reference's GlobalNodeIdService
+  singleton, GlobalNodeIdService.scala:15-72).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import TYPE_CHECKING, Any, Optional
+
+from ..amqp.properties import BasicProperties
+from ..store.api import StoredQueue
+from .hashring import HashRing
+from .membership import ALIVE, Member, Membership
+from .rpc import RpcClient, RpcError, RpcServer
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..broker.broker import Broker
+    from ..broker.channel import ServerChannel
+    from ..broker.entities import Delivery, Queue, QueuedMessage
+
+log = logging.getLogger("chanamq.cluster")
+
+DEFAULT_CREDIT = 200
+
+
+class ClusterNode:
+    """Cluster extension attached to a Broker."""
+
+    def __init__(
+        self,
+        broker: "Broker",
+        host: str = "127.0.0.1",
+        port: int = 0,
+        seeds: Optional[list[str]] = None,
+        *,
+        virtual_nodes: int = 64,
+        heartbeat_interval_s: float = 1.0,
+        failure_timeout_s: float = 5.0,
+    ) -> None:
+        self.broker = broker
+        self.rpc = RpcServer(host, port)
+        self._host = host
+        self._seeds = seeds or []
+        self._hb = heartbeat_interval_s
+        self._ft = failure_timeout_s
+        self.membership: Optional[Membership] = None
+        self.ring = HashRing([], virtual_nodes)
+        # replicated queue-meta registry: (vhost, name) -> meta dict
+        self.queue_metas: dict[tuple[str, str], dict] = {}
+        # origin-side registry of remote consumers for failover re-register:
+        # (vhost, queue, tag) -> info
+        self._remote_consumers: dict[tuple[str, str, str], dict] = {}
+        self.name: str = ""
+        broker.cluster = self
+        self._register_handlers()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        await self.rpc.start()
+        self.name = f"{self._host}:{self.rpc.bound_port}"
+        self.membership = Membership(
+            self.name, self._seeds, self.rpc,
+            heartbeat_interval_s=self._hb, failure_timeout_s=self._ft)
+        self.membership.listeners.append(self._on_membership_event)
+        await self.membership.start()
+        self.ring.set_nodes(self.membership.alive_members())
+        # pull metadata snapshot from the first reachable seed
+        for seed in self._seeds:
+            try:
+                snapshot = await self.membership.client(seed).call(
+                    "cluster.snapshot", {}, timeout_s=5)
+                await self._apply_snapshot(snapshot)
+                break
+            except (RpcError, OSError):
+                continue
+        # deactivate local queues this node does not own (boot recovery
+        # loaded everything; sharded ownership says otherwise)
+        self._deactivate_unowned()
+        # lease a snowflake worker id from the leader (reference:
+        # ServiceBoard blocking on AskNodeId, ServiceBoard.scala:40-48 —
+        # but bounded and non-blocking here)
+        import uuid as uuid_module
+
+        from .idgen import IdGenerator, MAX_WORKER_ID
+
+        try:
+            worker_id = await asyncio.wait_for(
+                self.acquire_worker_id(str(uuid_module.uuid4())), timeout=10)
+            self.broker.idgen = IdGenerator(worker_id & MAX_WORKER_ID)
+        except (asyncio.TimeoutError, RpcError, OSError):
+            log.warning("%s: worker-id lease failed; keeping local id", self.name)
+
+    async def stop(self) -> None:
+        if self.membership is not None:
+            await self.membership.stop()
+        await self.rpc.stop()
+
+    # ------------------------------------------------------------------
+    # ownership
+    # ------------------------------------------------------------------
+
+    def queue_owner(self, vhost: str, name: str) -> str:
+        owner = self.ring.owner_entity("q", vhost, name)
+        return owner or self.name
+
+    def owns_queue(self, vhost: str, name: str) -> bool:
+        return self.queue_owner(vhost, name) == self.name
+
+    def is_remote_queue(self, vhost: str, name: str) -> bool:
+        """True when ops on this queue must be proxied: it is a known
+        clustered (non-exclusive) queue owned elsewhere."""
+        vh = self.broker.vhosts.get(vhost)
+        if vh is not None:
+            queue = vh.queues.get(name)
+            if queue is not None:
+                # local exclusive queues are always local
+                return False
+        meta = self.queue_metas.get((vhost, name))
+        if meta is None:
+            return False
+        return not self.owns_queue(vhost, name)
+
+    def _deactivate_unowned(self) -> None:
+        for vhost in self.broker.vhosts.values():
+            for name in list(vhost.queues):
+                queue = vhost.queues[name]
+                if queue.exclusive_owner is not None:
+                    continue
+                self._register_meta(queue)
+                if self.owns_queue(vhost.name, name):
+                    continue
+                if queue.consumers or queue.messages or queue.outstanding:
+                    # Sticky: a queue with live local consumers/messages keeps
+                    # serving them; only idle shells hand off eagerly. Lazy
+                    # rebalance on join — new ops route to the ring owner
+                    # (known v1 limitation, akin to sharding without an
+                    # explicit handoff coordinator).
+                    continue
+                queue.deleted = True
+                del vhost.queues[name]
+
+    def _register_meta(self, queue: "Queue") -> None:
+        self.queue_metas[(queue.vhost, queue.name)] = {
+            "durable": queue.durable,
+            "auto_delete": queue.auto_delete,
+            "ttl_ms": queue.ttl_ms,
+            "arguments": dict(queue.arguments or {}),
+        }
+
+    # ------------------------------------------------------------------
+    # membership reactions
+    # ------------------------------------------------------------------
+
+    def _on_membership_event(self, event: str, member: Member) -> None:
+        assert self.membership is not None
+        self.ring.set_nodes(self.membership.alive_members())
+        self._deactivate_unowned()
+        # re-register remote consumers whose queues changed owner; also
+        # requeue outstanding deliveries from consumers whose origin died
+        if event == "down":
+            self._drop_origin_consumers(member.name)
+        asyncio.get_event_loop().create_task(self._reconcile_consumers())
+
+    def _drop_origin_consumers(self, origin: str) -> None:
+        for vhost in self.broker.vhosts.values():
+            for queue in vhost.queues.values():
+                for consumer in list(queue.consumers):
+                    if isinstance(consumer, RemoteConsumer) and consumer.origin == origin:
+                        consumer.requeue_outstanding()
+                        queue.consumers.remove(consumer)
+
+    _reconcile_retry_pending = False
+
+    async def _reconcile_consumers(self) -> None:
+        any_failed = False
+        for (vhost, queue, tag), info in list(self._remote_consumers.items()):
+            owner = self.queue_owner(vhost, queue)
+            if owner == info.get("owner") and info.get("alive", True):
+                continue
+            try:
+                if owner == self.name:
+                    # queue came home: activate it locally; the origin-side
+                    # stub keeps working because deliveries now come from
+                    # the local dispatch through the same stub channel
+                    local_queue = await self.broker.activate_queue(vhost, queue)
+                    if local_queue is not None:
+                        stub = info["stub"]
+                        if stub not in local_queue.consumers:
+                            local_queue.add_consumer(stub)
+                    info["owner"] = owner
+                    continue
+                await self._call(owner, "queue.activate",
+                                 {"vhost": vhost, "name": queue})
+                await self._call(owner, "queue.consume", {
+                    "vhost": vhost, "queue": queue, "tag": tag,
+                    "no_ack": info["no_ack"], "origin": self.name,
+                    "credit": info["credit"],
+                })
+                info["owner"] = owner
+                info["alive"] = True
+                log.info("%s: re-registered consumer %s on %s", self.name, tag, owner)
+            except (RpcError, OSError) as exc:
+                log.warning("%s: consumer re-register failed (%s); retrying", self.name, exc)
+                info["alive"] = False
+                any_failed = True
+        # exactly one pending retry regardless of how many consumers failed
+        if any_failed and not self._reconcile_retry_pending:
+            self._reconcile_retry_pending = True
+            loop = asyncio.get_event_loop()
+
+            def _retry() -> None:
+                self._reconcile_retry_pending = False
+                loop.create_task(self._reconcile_consumers())
+
+            loop.call_later(1.0, _retry)
+
+    # ------------------------------------------------------------------
+    # RPC plumbing
+    # ------------------------------------------------------------------
+
+    async def _call(self, node: str, method: str, payload: dict) -> dict:
+        assert self.membership is not None
+        return await self.membership.client(node).call(method, payload)
+
+    async def _event(self, node: str, method: str, payload: dict) -> None:
+        assert self.membership is not None
+        try:
+            await self.membership.client(node).send_event(method, payload)
+        except (RpcError, OSError):
+            pass
+
+    async def broadcast(self, method: str, payload: dict) -> None:
+        assert self.membership is not None
+        for node in self.membership.alive_members():
+            if node != self.name:
+                await self._event(node, method, payload)
+
+    def broadcast_bg(self, method: str, payload: dict) -> None:
+        asyncio.get_event_loop().create_task(self.broadcast(method, payload))
+
+    def _register_handlers(self) -> None:
+        rpc = self.rpc
+        rpc.register("cluster.snapshot", self._h_snapshot)
+        rpc.register("cluster.node-id", self._h_node_id)
+        rpc.register("meta.apply", self._h_meta_apply)
+        rpc.register("queue.declare", self._h_queue_declare)
+        rpc.register("queue.activate", self._h_queue_activate)
+        rpc.register("queue.delete", self._h_queue_delete)
+        rpc.register("queue.purge", self._h_queue_purge)
+        rpc.register("queue.stats", self._h_queue_stats)
+        rpc.register("queue.push", self._h_queue_push)
+        rpc.register("queue.get", self._h_queue_get)
+        rpc.register("queue.consume", self._h_queue_consume)
+        rpc.register("queue.cancel", self._h_queue_cancel)
+        rpc.register("queue.settle", self._h_queue_settle)
+        rpc.register("consumer.deliver", self._h_consumer_deliver)
+        rpc.register("consumer.credit", self._h_consumer_credit)
+
+    # ------------------------------------------------------------------
+    # metadata replication
+    # ------------------------------------------------------------------
+
+    def _snapshot(self) -> dict:
+        exchanges = []
+        for vhost in self.broker.vhosts.values():
+            for exchange in vhost.exchanges.values():
+                if not exchange.name and vhost.name:
+                    continue
+                exchanges.append({
+                    "vhost": vhost.name, "name": exchange.name,
+                    "type": exchange.type, "durable": exchange.durable,
+                    "auto_delete": exchange.auto_delete,
+                    "internal": exchange.internal,
+                    "binds": [
+                        {"key": key, "queue": queue, "args": args or {}}
+                        for key, queue, args in exchange.matcher.bindings()
+                    ],
+                })
+        return {
+            "vhosts": {v.name: v.active for v in self.broker.vhosts.values()},
+            "exchanges": exchanges,
+            "queues": {
+                f"{vh}\x00{name}": meta
+                for (vh, name), meta in self.queue_metas.items()
+            },
+        }
+
+    async def _h_snapshot(self, payload: dict) -> dict:
+        return self._snapshot()
+
+    async def _apply_snapshot(self, snapshot: dict) -> None:
+        for vhost_name, active in (snapshot.get("vhosts") or {}).items():
+            if vhost_name not in self.broker.vhosts:
+                await self.broker.create_vhost(vhost_name)
+            self.broker.vhosts[vhost_name].active = bool(active)
+        for ex in snapshot.get("exchanges") or []:
+            await self._h_meta_apply({"kind": "exchange.declared", **ex})
+        for key, meta in (snapshot.get("queues") or {}).items():
+            vhost, _, name = key.partition("\x00")
+            self.queue_metas[(vhost, name)] = dict(meta)
+
+    async def _h_meta_apply(self, payload: dict) -> dict:
+        """Apply one replicated metadata mutation (broadcast receiver)."""
+        kind = str(payload.get("kind"))
+        vhost_name = str(payload.get("vhost", ""))
+        if kind == "vhost.created":
+            if vhost_name not in self.broker.vhosts:
+                from ..broker.entities import VHost
+
+                self.broker.vhosts[vhost_name] = VHost(vhost_name)
+            return {}
+        if kind == "vhost.deleted":
+            self.broker.vhosts.pop(vhost_name, None)
+            return {}
+        vhost = self.broker.vhosts.get(vhost_name)
+        if vhost is None:
+            from ..broker.entities import VHost
+
+            vhost = VHost(vhost_name)
+            self.broker.vhosts[vhost_name] = vhost
+        if kind == "exchange.declared":
+            from ..broker.entities import Exchange
+
+            name = str(payload["name"])
+            if name not in vhost.exchanges:
+                vhost.exchanges[name] = Exchange(
+                    vhost_name, name, str(payload["type"]),
+                    durable=bool(payload.get("durable")),
+                    auto_delete=bool(payload.get("auto_delete")),
+                    internal=bool(payload.get("internal")),
+                )
+            exchange = vhost.exchanges[name]
+            for bind in payload.get("binds") or []:
+                exchange.matcher.bind(
+                    str(bind["key"]), str(bind["queue"]), bind.get("args"))
+            return {}
+        if kind == "exchange.deleted":
+            vhost.exchanges.pop(str(payload["name"]), None)
+            return {}
+        if kind == "bind.added":
+            exchange = vhost.exchanges.get(str(payload["exchange"]))
+            if exchange is not None:
+                exchange.matcher.bind(
+                    str(payload["key"]), str(payload["queue"]),
+                    payload.get("args") or None)
+            return {}
+        if kind == "bind.removed":
+            exchange = vhost.exchanges.get(str(payload["exchange"]))
+            if exchange is not None:
+                exchange.matcher.unbind(
+                    str(payload["key"]), str(payload["queue"]),
+                    payload.get("args") or None)
+            return {}
+        if kind == "queue.declared":
+            self.queue_metas[(vhost_name, str(payload["name"]))] = {
+                "durable": bool(payload.get("durable")),
+                "auto_delete": bool(payload.get("auto_delete")),
+                "ttl_ms": payload.get("ttl_ms"),
+                "arguments": payload.get("arguments") or {},
+            }
+            return {}
+        if kind == "queue.deleted":
+            name = str(payload["name"])
+            self.queue_metas.pop((vhost_name, name), None)
+            # the reference broadcasts QueueDeleted so exchanges drop binds
+            for exchange in vhost.exchanges.values():
+                exchange.matcher.unbind_queue(name)
+            queue = vhost.queues.get(name)
+            if queue is not None:
+                queue.deleted = True
+                del vhost.queues[name]
+            return {}
+        return {}
+
+    # ------------------------------------------------------------------
+    # node-id lease (snowflake worker ids)
+    # ------------------------------------------------------------------
+
+    async def _h_node_id(self, payload: dict) -> dict:
+        """Leader hands out monotonically increasing worker ids keyed by
+        caller uuid (reference: GlobalNodeIdService.AskNodeId). The counter
+        lives in the shared durable store, so ids never repeat even across
+        leader failovers."""
+        if not hasattr(self, "_lease_map"):
+            self._lease_map: dict[str, int] = {}
+        uuid = str(payload.get("uuid", ""))
+        if uuid not in self._lease_map:
+            self._lease_map[uuid] = await self.broker.store.allocate_worker_id()
+        return {"worker_id": self._lease_map[uuid]}
+
+    async def acquire_worker_id(self, uuid: str) -> int:
+        assert self.membership is not None
+        leader = self.membership.leader()
+        if leader == self.name:
+            return (await self._h_node_id({"uuid": uuid}))["worker_id"]
+        reply = await self._call(leader, "cluster.node-id", {"uuid": uuid})
+        return int(reply["worker_id"])
+
+    # ------------------------------------------------------------------
+    # owner-side queue op handlers
+    # ------------------------------------------------------------------
+
+    async def _local_queue(self, vhost: str, name: str) -> "Queue":
+        queue = await self.broker.activate_queue(vhost, name)
+        if queue is None:
+            raise RpcError("not_found", f"no queue '{name}' in '{vhost}'")
+        return queue
+
+    async def _h_queue_declare(self, payload: dict) -> dict:
+        queue = await self.broker.declare_queue(
+            str(payload["vhost"]), str(payload["name"]),
+            durable=bool(payload.get("durable")),
+            auto_delete=bool(payload.get("auto_delete")),
+            arguments=payload.get("arguments") or {},
+        )
+        return {"message_count": queue.message_count,
+                "consumer_count": queue.consumer_count}
+
+    async def _h_queue_activate(self, payload: dict) -> dict:
+        queue = await self.broker.activate_queue(
+            str(payload["vhost"]), str(payload["name"]))
+        return {"active": queue is not None}
+
+    async def _h_queue_delete(self, payload: dict) -> dict:
+        count = await self.broker.delete_queue(
+            str(payload["vhost"]), str(payload["name"]),
+            if_unused=bool(payload.get("if_unused")),
+            if_empty=bool(payload.get("if_empty")))
+        return {"message_count": count}
+
+    async def _h_queue_purge(self, payload: dict) -> dict:
+        queue = await self._local_queue(str(payload["vhost"]), str(payload["name"]))
+        return {"message_count": queue.purge()}
+
+    async def _h_queue_stats(self, payload: dict) -> dict:
+        queue = await self._local_queue(str(payload["vhost"]), str(payload["name"]))
+        return {"message_count": queue.message_count,
+                "consumer_count": queue.consumer_count}
+
+    async def _h_queue_push(self, payload: dict) -> dict:
+        """Accept routed messages for locally-owned queues (the reference's
+        QueueEntity.Push ask, QueueEntity.scala:271-316)."""
+        from ..broker.entities import Message
+
+        vhost = str(payload["vhost"])
+        queue_names = [str(q) for q in payload.get("queues") or []]
+        _, _, props = BasicProperties.decode_header(bytes(payload["props_raw"]))
+        check_consumers = bool(payload.get("check_consumers"))
+        body = bytes(payload["body"])
+        had_consumer = False
+        queues = []
+        for name in queue_names:
+            queue = await self.broker.activate_queue(vhost, name)
+            if queue is not None:
+                queues.append(queue)
+                if any(c.can_take(len(body)) for c in queue.consumers):
+                    had_consumer = True
+        if bool(payload.get("check_only")):
+            return {"pushed": False, "had_consumer": had_consumer}
+        if check_consumers and not had_consumer:
+            return {"pushed": False, "had_consumer": False}
+        if queues:
+            message = Message(
+                self.broker.idgen.next_id(), props, body,
+                str(payload["exchange"]), str(payload["routing_key"]),
+                props.expiration_ms(),
+            )
+            message.refer_count = len(queues)
+            persist = message.is_persistent and any(q.durable for q in queues)
+            if persist:
+                message.persisted = True
+                from ..store.api import StoredMessage
+
+                await self.broker.store.insert_message(StoredMessage(
+                    id=message.id, properties_raw=bytes(payload["props_raw"]),
+                    body=body, exchange=message.exchange,
+                    routing_key=message.routing_key,
+                    refer_count=len(queues), ttl_ms=message.ttl_ms,
+                ))
+            for queue in queues:
+                queue.push(message)
+        return {"pushed": bool(queues), "had_consumer": had_consumer}
+
+    async def _h_queue_get(self, payload: dict) -> dict:
+        queue = await self._local_queue(str(payload["vhost"]), str(payload["queue"]))
+        qm = queue.basic_get()
+        if qm is None:
+            return {"empty": True, "message_count": queue.message_count}
+        msg = qm.message
+        out = {
+            "empty": False,
+            "offset": qm.offset,
+            "redelivered": qm.redelivered,
+            "exchange": msg.exchange,
+            "routing_key": msg.routing_key,
+            "props_raw": msg.properties.encode_header(len(msg.body)),
+            "body": msg.body,
+            "msg_id": msg.id,
+            "expire_at_ms": qm.expire_at_ms,
+            "message_count": queue.message_count,
+        }
+        if bool(payload.get("no_ack")):
+            self.broker.unrefer(msg)
+        else:
+            from ..broker.entities import Delivery
+
+            delivery = Delivery(qm, queue, None, "", 0, no_ack=False)  # type: ignore[arg-type]
+            queue.outstanding[qm.offset] = delivery
+            if queue.durable and msg.persisted:
+                self.broker.store_bg(self.broker.store.insert_queue_unacks(
+                    queue.vhost, queue.name,
+                    [(msg.id, qm.offset, len(msg.body), qm.expire_at_ms)]))
+        return out
+
+    async def _h_queue_consume(self, payload: dict) -> dict:
+        queue = await self._local_queue(str(payload["vhost"]), str(payload["queue"]))
+        tag = str(payload["tag"])
+        origin = str(payload["origin"])
+        # idempotent re-register: replace any previous incarnation
+        for consumer in list(queue.consumers):
+            if isinstance(consumer, RemoteConsumer) and consumer.tag == tag \
+                    and consumer.origin == origin:
+                queue.consumers.remove(consumer)
+        consumer = RemoteConsumer(
+            self, tag, queue, bool(payload.get("no_ack")), origin,
+            int(payload.get("credit", DEFAULT_CREDIT)))
+        queue.add_consumer(consumer)
+        return {"ok": True}
+
+    async def _h_queue_cancel(self, payload: dict) -> dict:
+        vhost = self.broker.vhosts.get(str(payload["vhost"]))
+        queue = vhost.queues.get(str(payload["queue"])) if vhost else None
+        if queue is None:
+            return {"ok": False}
+        tag = str(payload["tag"])
+        origin = str(payload["origin"])
+        for consumer in list(queue.consumers):
+            if isinstance(consumer, RemoteConsumer) and consumer.tag == tag \
+                    and consumer.origin == origin:
+                if bool(payload.get("requeue_outstanding", True)):
+                    consumer.requeue_outstanding()
+                auto_deleted = queue.remove_consumer(consumer)
+                if auto_deleted:
+                    self.broker.schedule_queue_delete(queue.vhost, queue.name)
+        return {"ok": True}
+
+    async def _h_queue_settle(self, payload: dict) -> dict:
+        """Ack/drop/requeue outstanding deliveries by offset (origin -> owner);
+        also replenishes the remote consumer's credit."""
+        vhost = self.broker.vhosts.get(str(payload["vhost"]))
+        queue = vhost.queues.get(str(payload["queue"])) if vhost else None
+        if queue is None:
+            return {"ok": False}
+        op = str(payload.get("op", "ack"))
+        offsets = [int(o) for o in payload.get("offsets") or []]
+        for offset in offsets:
+            delivery = queue.outstanding.get(offset)
+            if delivery is None:
+                continue
+            if op == "ack":
+                queue.ack(delivery)
+            elif op == "drop":
+                queue.drop(delivery)
+            else:
+                queue.requeue(delivery)
+        tag = str(payload.get("tag", ""))
+        credit = int(payload.get("credit", 0))
+        if tag and credit:
+            for consumer in queue.consumers:
+                if isinstance(consumer, RemoteConsumer) and consumer.tag == tag:
+                    consumer.credit += credit
+                    for offset in offsets:
+                        consumer.outstanding_offsets.discard(offset)
+        queue.schedule_dispatch()
+        return {"ok": True}
+
+    async def _h_consumer_credit(self, payload: dict) -> dict:
+        vhost = self.broker.vhosts.get(str(payload["vhost"]))
+        queue = vhost.queues.get(str(payload["queue"])) if vhost else None
+        if queue is None:
+            return {"ok": False}
+        tag = str(payload["tag"])
+        for consumer in queue.consumers:
+            if isinstance(consumer, RemoteConsumer) and consumer.tag == tag:
+                consumer.credit += int(payload.get("credit", 0))
+        queue.schedule_dispatch()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # origin-side: deliveries arriving from owners
+    # ------------------------------------------------------------------
+
+    async def _h_consumer_deliver(self, payload: dict) -> dict:
+        from ..broker.entities import Message, QueuedMessage
+
+        key = (str(payload["vhost"]), str(payload["queue"]), str(payload["tag"]))
+        info = self._remote_consumers.get(key)
+        if info is None:
+            return {"ok": False}
+        stub = info["stub"]
+        channel: "ServerChannel" = info["channel"]
+        if channel.closed:
+            return {"ok": False}
+        _, _, props = BasicProperties.decode_header(bytes(payload["props_raw"]))
+        message = Message(
+            int(payload["msg_id"]), props, bytes(payload["body"]),
+            str(payload["exchange"]), str(payload["routing_key"]))
+        qm = QueuedMessage(message, int(payload["offset"]), payload.get("expire_at_ms"))
+        qm.redelivered = bool(payload.get("redelivered"))
+        channel.deliver(stub, stub.queue, qm)
+        if info["no_ack"]:
+            # replenish credit as we render (owner decremented on send)
+            info["pending_credit"] = info.get("pending_credit", 0) + 1
+            if info["pending_credit"] >= 32:
+                credit = info["pending_credit"]
+                info["pending_credit"] = 0
+                await self._event(info["owner"], "consumer.credit", {
+                    "vhost": key[0], "queue": key[1], "tag": key[2],
+                    "credit": credit})
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # origin-side proxy API (used by broker/connection)
+    # ------------------------------------------------------------------
+
+    async def remote_declare(self, vhost: str, name: str, **kwargs: Any) -> dict:
+        owner = self.queue_owner(vhost, name)
+        return await self._call(owner, "queue.declare",
+                                {"vhost": vhost, "name": name, **kwargs})
+
+    async def remote_delete(self, vhost: str, name: str, *,
+                            if_unused: bool = False, if_empty: bool = False) -> int:
+        owner = self.queue_owner(vhost, name)
+        reply = await self._call(owner, "queue.delete", {
+            "vhost": vhost, "name": name,
+            "if_unused": if_unused, "if_empty": if_empty})
+        return int(reply.get("message_count", 0))
+
+    async def remote_purge(self, vhost: str, name: str) -> int:
+        owner = self.queue_owner(vhost, name)
+        reply = await self._call(owner, "queue.purge", {"vhost": vhost, "name": name})
+        return int(reply.get("message_count", 0))
+
+    async def remote_stats(self, vhost: str, name: str) -> tuple[int, int]:
+        owner = self.queue_owner(vhost, name)
+        reply = await self._call(owner, "queue.stats", {"vhost": vhost, "name": name})
+        return int(reply.get("message_count", 0)), int(reply.get("consumer_count", 0))
+
+    async def remote_push(
+        self, owner: str, vhost: str, queues: list[str], props_raw: bytes,
+        body: bytes, exchange: str, routing_key: str, check_consumers: bool,
+        check_only: bool = False,
+    ) -> tuple[bool, bool]:
+        reply = await self._call(owner, "queue.push", {
+            "vhost": vhost, "queues": queues, "props_raw": props_raw,
+            "body": body, "exchange": exchange, "routing_key": routing_key,
+            "check_consumers": check_consumers, "check_only": check_only,
+        })
+        return bool(reply.get("pushed")), bool(reply.get("had_consumer"))
+
+    async def remote_get(self, vhost: str, name: str, no_ack: bool) -> dict:
+        owner = self.queue_owner(vhost, name)
+        return await self._call(owner, "queue.get", {
+            "vhost": vhost, "queue": name, "no_ack": no_ack})
+
+    async def remote_consume(
+        self, channel: "ServerChannel", vhost: str, name: str, tag: str,
+        no_ack: bool, credit: int = DEFAULT_CREDIT,
+    ) -> "RemoteQueueRef":
+        owner = self.queue_owner(vhost, name)
+        ref = RemoteQueueRef(self, vhost, name)
+        from ..broker.channel import Consumer
+
+        stub = Consumer(tag, channel, ref, no_ack, False)  # type: ignore[arg-type]
+        self._remote_consumers[(vhost, name, tag)] = {
+            "channel": channel, "stub": stub, "no_ack": no_ack,
+            "credit": credit, "owner": owner, "pending_credit": 0,
+        }
+        try:
+            await self._call(owner, "queue.consume", {
+                "vhost": vhost, "queue": name, "tag": tag,
+                "no_ack": no_ack, "origin": self.name, "credit": credit})
+        except Exception:
+            self._remote_consumers.pop((vhost, name, tag), None)
+            raise
+        channel.consumers[tag] = stub
+        return ref
+
+    async def remote_cancel(self, vhost: str, name: str, tag: str) -> None:
+        info = self._remote_consumers.pop((vhost, name, tag), None)
+        if info is None:
+            return
+        try:
+            await self._call(info["owner"], "queue.cancel", {
+                "vhost": vhost, "queue": name, "tag": tag, "origin": self.name})
+        except (RpcError, OSError):
+            pass
+
+    def settle_bg(self, vhost: str, name: str, op: str, offsets: list[int],
+                  tag: str = "", credit: int = 0) -> None:
+        owner = self.queue_owner(vhost, name)
+
+        async def _settle() -> None:
+            try:
+                await self._call(owner, "queue.settle", {
+                    "vhost": vhost, "queue": name, "op": op,
+                    "offsets": offsets, "tag": tag, "credit": credit})
+            except (RpcError, OSError) as exc:
+                log.warning("settle %s %s failed: %s", op, offsets, exc)
+
+        asyncio.get_event_loop().create_task(_settle())
+
+
+class RemoteConsumer:
+    """Owner-side representation of a consumer living on another node.
+    Implements the Consumer dispatch interface (can_take / deliver / detach)."""
+
+    __slots__ = ("cluster", "tag", "queue", "no_ack", "origin", "credit",
+                 "exclusive", "outstanding_offsets")
+
+    def __init__(self, cluster: ClusterNode, tag: str, queue: "Queue",
+                 no_ack: bool, origin: str, credit: int) -> None:
+        self.cluster = cluster
+        self.tag = tag
+        self.queue = queue
+        self.no_ack = no_ack
+        self.origin = origin
+        self.credit = credit
+        self.exclusive = False
+        self.outstanding_offsets: set[int] = set()
+
+    def can_take(self, next_size: int) -> bool:
+        if self.credit <= 0:
+            return False
+        membership = self.cluster.membership
+        return membership is None or membership.is_alive(self.origin)
+
+    def deliver(self, queue: "Queue", qm: "QueuedMessage") -> Optional["Delivery"]:
+        from ..broker.entities import Delivery
+
+        self.credit -= 1
+        msg = qm.message
+        payload = {
+            "vhost": queue.vhost, "queue": queue.name, "tag": self.tag,
+            "offset": qm.offset, "redelivered": qm.redelivered,
+            "exchange": msg.exchange, "routing_key": msg.routing_key,
+            "props_raw": msg.properties.encode_header(len(msg.body)),
+            "body": msg.body, "msg_id": msg.id,
+            "expire_at_ms": qm.expire_at_ms,
+        }
+        asyncio.get_event_loop().create_task(
+            self.cluster._event(self.origin, "consumer.deliver", payload))
+        if self.no_ack:
+            return None
+        self.outstanding_offsets.add(qm.offset)
+        return Delivery(qm, queue, None, self.tag, 0, no_ack=False)  # type: ignore[arg-type]
+
+    def detach(self) -> None:
+        pass
+
+    def requeue_outstanding(self) -> None:
+        for offset in sorted(self.outstanding_offsets):
+            delivery = self.queue.outstanding.get(offset)
+            if delivery is not None:
+                self.queue.requeue(delivery)
+        self.outstanding_offsets.clear()
+
+
+class RemoteQueueRef:
+    """Origin-side facade standing in for a remotely-owned queue in the
+    channel bookkeeping (ack/requeue/drop route over RPC)."""
+
+    __slots__ = ("cluster", "vhost", "name")
+
+    def __init__(self, cluster: ClusterNode, vhost: str, name: str) -> None:
+        self.cluster = cluster
+        self.vhost = vhost
+        self.name = name
+
+    # channel bookkeeping hooks ------------------------------------------
+
+    def ack(self, delivery: "Delivery") -> None:
+        self.cluster.settle_bg(
+            self.vhost, self.name, "ack", [delivery.queued.offset],
+            tag=delivery.consumer_tag, credit=1)
+
+    def drop(self, delivery: "Delivery") -> None:
+        self.cluster.settle_bg(
+            self.vhost, self.name, "drop", [delivery.queued.offset],
+            tag=delivery.consumer_tag, credit=1)
+
+    def requeue(self, delivery: "Delivery") -> None:
+        self.cluster.settle_bg(
+            self.vhost, self.name, "requeue", [delivery.queued.offset],
+            tag=delivery.consumer_tag, credit=1)
+
+    def schedule_dispatch(self) -> None:
+        pass
+
+    def remove_consumer(self, consumer: Any) -> bool:
+        asyncio.get_event_loop().create_task(
+            self.cluster.remote_cancel(self.vhost, self.name, consumer.tag))
+        return False
+
+    @property
+    def consumers(self) -> list:
+        return []
+
+    def has_exclusive_consumer(self) -> bool:
+        return False

@@ -1,0 +1,249 @@
+"""Host-to-host RPC over TCP.
+
+The DCN control-plane analogue of the reference's Akka artery remoting
+(chana-mq-base reference.conf:16-23; messaging pattern SURVEY.md §5:
+request/response `ask` with timeout + fire-and-forget `tell`). Wire format
+reuses the framework's own AMQP field-table codec for payloads (tables carry
+nested tables, byte arrays, ints — everything entity ops need), so the
+cluster layer introduces no second serialization scheme and no pickle.
+
+Frame: u32 body-length | u64 correlation-id | u8 kind | shortstr method |
+       table payload
+kinds: 0=request 1=response 2=error 3=event (fire-and-forget)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from io import BytesIO
+from typing import Any, Awaitable, Callable, Optional
+
+from ..amqp import value_codec as vc
+
+log = logging.getLogger("chanamq.rpc")
+
+KIND_REQUEST = 0
+KIND_RESPONSE = 1
+KIND_ERROR = 2
+KIND_EVENT = 3
+
+_HEAD = struct.Struct(">IQB")
+MAX_FRAME = 64 * 1024 * 1024
+
+Handler = Callable[[dict], Awaitable[Optional[dict]]]
+
+
+class RpcError(Exception):
+    def __init__(self, code: str, message: str) -> None:
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.message = message
+
+
+class RpcTimeout(RpcError):
+    def __init__(self, method: str) -> None:
+        super().__init__("timeout", f"rpc {method} timed out")
+
+
+def _encode(corr_id: int, kind: int, method: str, payload: dict) -> bytes:
+    body = BytesIO()
+    vc.write_shortstr(body, method)
+    vc.write_table(body, payload)
+    data = body.getvalue()
+    return _HEAD.pack(len(data) + 9, corr_id, kind) + data
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> tuple[int, int, str, dict]:
+    head = await reader.readexactly(4)
+    (length,) = struct.unpack(">I", head)
+    if length > MAX_FRAME:
+        raise RpcError("frame_too_large", f"{length} bytes")
+    body = await reader.readexactly(length)
+    corr_id, kind = struct.unpack_from(">QB", body)
+    stream = BytesIO(body[9:])
+    method = vc.read_shortstr(stream)
+    payload = vc.read_table(stream)
+    return corr_id, kind, method, payload
+
+
+class RpcServer:
+    """Listens for peer connections; dispatches requests to handlers."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host = host
+        self.port = port
+        self.handlers: dict[str, Handler] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._peer_writers: set[asyncio.StreamWriter] = set()
+
+    def register(self, method: str, handler: Handler) -> None:
+        self.handlers[method] = handler
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_client, self.host, self.port)
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # close accepted connections first: py3.12 wait_closed() blocks
+            # until every connection handler finishes
+            for writer in list(self._peer_writers):
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _on_client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._peer_writers.add(writer)
+        try:
+            while True:
+                corr_id, kind, method, payload = await _read_frame(reader)
+                if kind == KIND_EVENT:
+                    handler = self.handlers.get(method)
+                    if handler is not None:
+                        # events are fire-and-forget; run concurrently
+                        asyncio.get_event_loop().create_task(
+                            self._run_event(handler, method, payload))
+                    continue
+                if kind != KIND_REQUEST:
+                    continue
+                asyncio.get_event_loop().create_task(
+                    self._run_request(writer, corr_id, method, payload))
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except Exception:
+            log.exception("rpc server connection failed")
+        finally:
+            self._peer_writers.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _run_event(self, handler: Handler, method: str, payload: dict) -> None:
+        try:
+            await handler(payload)
+        except Exception:
+            log.exception("rpc event handler %s failed", method)
+
+    async def _run_request(
+        self, writer: asyncio.StreamWriter, corr_id: int, method: str, payload: dict
+    ) -> None:
+        handler = self.handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError("no_such_method", method)
+            result = await handler(payload)
+            frame = _encode(corr_id, KIND_RESPONSE, method, result or {})
+        except RpcError as exc:
+            frame = _encode(corr_id, KIND_ERROR, method,
+                            {"code": exc.code, "message": exc.message})
+        except Exception as exc:
+            log.exception("rpc handler %s failed", method)
+            frame = _encode(corr_id, KIND_ERROR, method,
+                            {"code": "internal", "message": str(exc)})
+        try:
+            writer.write(frame)
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+
+
+class RpcClient:
+    """One outgoing connection to a peer, with correlation-id matching.
+    Reconnects lazily on next call after a drop."""
+
+    def __init__(self, host: str, port: int, *, timeout_s: float = 20.0) -> None:
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s  # the reference's 20 s internal ask timeout
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._waiters: dict[int, asyncio.Future] = {}
+        self._next_corr = 1
+        self._connect_lock = asyncio.Lock()
+        self.closed = False
+
+    async def _ensure_connected(self) -> asyncio.StreamWriter:
+        if self._writer is not None and not self._writer.is_closing():
+            return self._writer
+        async with self._connect_lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return self._writer
+            reader, writer = await asyncio.open_connection(self.host, self.port)
+            self._writer = writer
+            self._reader_task = asyncio.get_event_loop().create_task(
+                self._read_loop(reader))
+            return writer
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                corr_id, kind, _method, payload = await _read_frame(reader)
+                fut = self._waiters.pop(corr_id, None)
+                if fut is None or fut.done():
+                    continue
+                if kind == KIND_RESPONSE:
+                    fut.set_result(payload)
+                elif kind == KIND_ERROR:
+                    fut.set_exception(RpcError(
+                        str(payload.get("code", "unknown")),
+                        str(payload.get("message", ""))))
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self._fail_waiters(RpcError("disconnected", f"{self.host}:{self.port}"))
+            self._writer = None
+
+    def _fail_waiters(self, exc: Exception) -> None:
+        for fut in self._waiters.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._waiters.clear()
+
+    async def call(
+        self, method: str, payload: Optional[dict] = None,
+        timeout_s: Optional[float] = None,
+    ) -> dict:
+        writer = await self._ensure_connected()
+        corr_id = self._next_corr
+        self._next_corr += 1
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._waiters[corr_id] = fut
+        writer.write(_encode(corr_id, KIND_REQUEST, method, payload or {}))
+        await writer.drain()
+        try:
+            return await asyncio.wait_for(fut, timeout_s or self.timeout_s)
+        except asyncio.TimeoutError:
+            self._waiters.pop(corr_id, None)
+            raise RpcTimeout(method) from None
+
+    async def send_event(self, method: str, payload: Optional[dict] = None) -> None:
+        """Fire-and-forget (the reference's `tell`)."""
+        writer = await self._ensure_connected()
+        writer.write(_encode(0, KIND_EVENT, method, payload or {}))
+        await writer.drain()
+
+    async def close(self) -> None:
+        self.closed = True
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+            self._writer = None
+        self._fail_waiters(RpcError("closed", "client closed"))

@@ -163,6 +163,13 @@ class StoreService:
     async def delete_queue_binds(self, vhost: str, queue: str) -> None:
         raise NotImplementedError
 
+    # -- cluster worker-id allocation (reference: GlobalNodeIdService hands
+    #    out monotonically increasing ids; here the shared store is the
+    #    durable counter so ids never repeat across leader failovers) ------
+
+    async def allocate_worker_id(self) -> int:
+        raise NotImplementedError
+
     # -- vhosts (reference: insertVhost/selectAllVhosts/deleteVhost) -------
 
     async def insert_vhost(self, name: str, active: bool = True) -> None:

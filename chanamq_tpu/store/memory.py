@@ -20,6 +20,7 @@ class MemoryStore(StoreService):
         self.exchanges: dict[tuple[str, str], StoredExchange] = {}
         self.vhosts: dict[str, bool] = {}
         self.archived: dict[tuple[str, str], StoredQueue] = {}
+        self._next_worker_id = 0
 
     async def open(self) -> None:
         pass
@@ -153,6 +154,10 @@ class MemoryStore(StoreService):
         for (vh, _), ex in self.exchanges.items():
             if vh == vhost:
                 ex.binds = [b for b in ex.binds if b[1] != queue]
+
+    async def allocate_worker_id(self) -> int:
+        self._next_worker_id += 1
+        return self._next_worker_id
 
     # -- vhosts ------------------------------------------------------------
 

@@ -58,6 +58,7 @@ CREATE TABLE IF NOT EXISTS binds (
   PRIMARY KEY (vhost, exchange, queue, routing_key)
 );
 CREATE TABLE IF NOT EXISTS vhosts (name TEXT PRIMARY KEY, active INTEGER);
+CREATE TABLE IF NOT EXISTS cluster_kv (key TEXT PRIMARY KEY, value INTEGER);
 CREATE TABLE IF NOT EXISTS queue_metas_deleted (
   vhost TEXT, name TEXT, meta TEXT, PRIMARY KEY (vhost, name)
 );
@@ -346,6 +347,28 @@ class SqliteStore(StoreService):
         await self._exec(lambda db: db.execute(
             "DELETE FROM binds WHERE vhost=? AND queue=?", (vhost, queue)
         ).connection.commit())
+
+    async def allocate_worker_id(self) -> int:
+        def w(db: sqlite3.Connection) -> int:
+            # atomic across processes sharing the file: BEGIN IMMEDIATE takes
+            # the write lock before the read-modify-write
+            db.execute("BEGIN IMMEDIATE")
+            try:
+                db.execute(
+                    "INSERT OR IGNORE INTO cluster_kv VALUES ('next_worker_id', 0)")
+                db.execute(
+                    "UPDATE cluster_kv SET value = value + 1 "
+                    "WHERE key = 'next_worker_id'")
+                row = db.execute(
+                    "SELECT value FROM cluster_kv WHERE key = 'next_worker_id'"
+                ).fetchone()
+                db.commit()
+                return int(row[0])
+            except Exception:
+                db.rollback()
+                raise
+
+        return await self._exec(w)
 
     # -- vhosts ------------------------------------------------------------
 
