@@ -116,7 +116,12 @@ class AMQPConnection:
         self.closing = False
         self.closed = asyncio.get_event_loop().create_future()
 
-        self._parser = FrameParser()
+        from .. import native_ext
+
+        if native_ext.available():
+            self._parser: FrameParser = native_ext.NativeFrameParser()
+        else:
+            self._parser = FrameParser()
         self._assembler = CommandAssembler()
         self._out = bytearray()
         self._out_event = asyncio.Event()

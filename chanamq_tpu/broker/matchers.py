@@ -277,6 +277,12 @@ def matcher_for(exchange_type: str) -> Matcher:
     if t == "fanout":
         return FanoutMatcher()
     if t == "topic":
+        # the C++ trie is the routing fast path when the native lib is built
+        # (chanamq_tpu.native_ext); same semantics, Python trie as fallback
+        from .. import native_ext
+
+        if native_ext.available():
+            return native_ext.NativeTopicMatcher()
         return TopicMatcher()
     if t == "headers":
         return HeadersMatcher()

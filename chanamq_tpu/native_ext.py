@@ -1,0 +1,216 @@
+"""ctypes bindings for the native hot paths (native/chanamq_native.cpp).
+
+Loads native/libchanamq_native.so, compiling it on first use when a C++
+toolchain is present. Falls back silently (callers keep the pure-Python
+implementations) when the library can't be built or CHANAMQ_NATIVE=0.
+
+Exposes:
+  NativeFrameParser  — drop-in for amqp.frame.FrameParser
+  NativeTopicMatcher — drop-in for broker.matchers.TopicMatcher
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Iterator, Optional
+
+from .amqp.constants import ErrorCode, FrameType
+from .amqp.frame import Frame, FrameError
+from .broker.matchers import Matcher
+
+log = logging.getLogger("chanamq.native")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libchanamq_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "chanamq_native.cpp")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except Exception as exc:
+        log.info("native build unavailable: %r", exc)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, building it on demand. None when unavailable."""
+    global _lib, _load_attempted
+    if _lib is not None:
+        return _lib
+    if _load_attempted:
+        return None
+    _load_attempted = True
+    if os.environ.get("CHANAMQ_NATIVE", "1") in ("0", "false", "no"):
+        return None
+    src = os.path.join(_NATIVE_DIR, "chanamq_native.cpp")
+    needs_build = not os.path.exists(_LIB_PATH) or (
+        os.path.exists(src)
+        and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH))
+    if needs_build and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as exc:
+        log.info("native lib load failed: %r", exc)
+        return None
+    lib.chana_scan_frames.restype = ctypes.c_int
+    lib.chana_scan_frames.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.chana_trie_new.restype = ctypes.c_void_p
+    lib.chana_trie_free.argtypes = [ctypes.c_void_p]
+    lib.chana_trie_bind.restype = ctypes.c_int
+    lib.chana_trie_bind.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
+    lib.chana_trie_unbind.restype = ctypes.c_int
+    lib.chana_trie_unbind.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
+    lib.chana_trie_route.restype = ctypes.c_int
+    lib.chana_trie_route.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+    ]
+    lib.chana_trie_size.restype = ctypes.c_int
+    lib.chana_trie_size.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    log.info("native hot paths loaded from %s", _LIB_PATH)
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+_MAX_FRAMES_PER_SCAN = 4096
+
+
+class NativeFrameParser:
+    """Drop-in FrameParser backed by the C scanner: one native call per read
+    chunk instead of a Python loop per frame."""
+
+    __slots__ = ("frame_max", "_buf", "_dead", "_lib",
+                 "_types", "_channels", "_offsets", "_lengths",
+                 "_consumed", "_error")
+
+    def __init__(self, frame_max: int = 0) -> None:
+        self.frame_max = frame_max
+        self._buf = bytearray()
+        self._dead = False
+        self._lib = load()
+        assert self._lib is not None, "native library unavailable"
+        self._types = (ctypes.c_int32 * _MAX_FRAMES_PER_SCAN)()
+        self._channels = (ctypes.c_int32 * _MAX_FRAMES_PER_SCAN)()
+        self._offsets = (ctypes.c_int64 * _MAX_FRAMES_PER_SCAN)()
+        self._lengths = (ctypes.c_int64 * _MAX_FRAMES_PER_SCAN)()
+        self._consumed = ctypes.c_int64()
+        self._error = ctypes.c_int32()
+
+    def feed(self, data: bytes) -> Iterator[Frame | FrameError]:
+        if self._dead:
+            return
+        buf = self._buf
+        buf += data
+        while True:
+            raw = bytes(buf)
+            n = self._lib.chana_scan_frames(
+                raw, len(raw), self.frame_max,
+                self._types, self._channels, self._offsets, self._lengths,
+                _MAX_FRAMES_PER_SCAN, ctypes.byref(self._consumed),
+                ctypes.byref(self._error))
+            for i in range(n):
+                off = self._offsets[i]
+                yield Frame(
+                    self._types[i], self._channels[i],
+                    raw[off : off + self._lengths[i]])
+            del buf[: self._consumed.value]
+            error = self._error.value
+            if error:
+                self._dead = True
+                if error == 1:
+                    yield FrameError(ErrorCode.FRAME_ERROR,
+                                     "unknown frame type")
+                elif error == 2:
+                    yield FrameError(
+                        ErrorCode.FRAME_ERROR,
+                        f"frame exceeds negotiated frame-max {self.frame_max}")
+                else:
+                    yield FrameError(ErrorCode.FRAME_ERROR,
+                                     "missing frame-end octet")
+                return
+            if n < _MAX_FRAMES_PER_SCAN:
+                return
+
+
+class NativeTopicMatcher(Matcher):
+    """Drop-in TopicMatcher routing through the C++ trie. The (pattern,
+    queue) registry stays Python-side for bindings()/recovery; the trie is
+    the routing fast path."""
+
+    def __init__(self) -> None:
+        lib = load()
+        assert lib is not None, "native library unavailable"
+        self._lib = lib
+        self._handle = ctypes.c_void_p(lib.chana_trie_new())
+        self._queue_ids: dict[str, int] = {}
+        self._queue_names: dict[int, str] = {}
+        self._next_id = 1
+        self._patterns: dict[tuple[str, str], int] = {}
+        self._out = (ctypes.c_int32 * 4096)()
+
+    def __del__(self) -> None:  # pragma: no cover
+        try:
+            if self._handle:
+                self._lib.chana_trie_free(self._handle)
+        except Exception:
+            pass
+
+    def _queue_id(self, queue: str) -> int:
+        qid = self._queue_ids.get(queue)
+        if qid is None:
+            qid = self._next_id
+            self._next_id += 1
+            self._queue_ids[queue] = qid
+            self._queue_names[qid] = queue
+        return qid
+
+    def bind(self, key: str, queue: str, arguments: Optional[dict] = None) -> bool:
+        if (key, queue) in self._patterns:
+            return False
+        self._patterns[(key, queue)] = 1
+        self._lib.chana_trie_bind(
+            self._handle, key.encode(), self._queue_id(queue))
+        return True
+
+    def unbind(self, key: str, queue: str, arguments: Optional[dict] = None) -> bool:
+        if self._patterns.pop((key, queue), None) is None:
+            return False
+        self._lib.chana_trie_unbind(
+            self._handle, key.encode(), self._queue_id(queue))
+        return True
+
+    def unbind_queue(self, queue: str) -> int:
+        keys = [k for (k, q) in self._patterns if q == queue]
+        for key in keys:
+            self.unbind(key, queue)
+        return len(keys)
+
+    def route(self, key: str, headers: Optional[dict] = None) -> set[str]:
+        n = self._lib.chana_trie_route(
+            self._handle, key.encode(), self._out, len(self._out))
+        return {self._queue_names[self._out[i]] for i in range(n)}
+
+    def bindings(self) -> list[tuple[str, str, Optional[dict]]]:
+        return [(k, q, None) for (k, q) in sorted(self._patterns)]

@@ -1,0 +1,199 @@
+// Native hot paths for chanamq_tpu: AMQP frame scanning and topic-trie
+// routing.
+//
+// SURVEY.md §7.1 names the two measured hot paths worth a compiled
+// implementation: (a) the frame parse loop (the reference's
+// FrameParser.scala byte handling) and (b) the topic matcher (the
+// reference's lock-free TrieMatcher, QueueMatcher.scala:140-601). Both are
+// exposed through a minimal C ABI consumed via ctypes — no pybind11 in this
+// image. The Python implementations remain as behavioral reference and
+// fallback.
+//
+// Build: make -C native   ->  native/libchanamq_native.so
+
+#include <cstdint>
+#include <cstring>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// frame scanning
+// ---------------------------------------------------------------------------
+
+// Scan `buf` for complete AMQP frames (type u8 | channel u16be | size u32be |
+// payload | 0xCE). Writes up to max_frames entries into the parallel output
+// arrays. Returns the number of frames found.
+//   *consumed  <- bytes covered by complete frames (caller trims its buffer)
+//   *error     <- 0 ok; 1 unknown frame type; 2 frame exceeds frame_max;
+//                 3 missing end octet
+// On error, frames found before the error are still reported.
+int chana_scan_frames(const uint8_t* buf, int64_t len, uint32_t frame_max,
+                      int32_t* types, int32_t* channels, int64_t* offsets,
+                      int64_t* lengths, int32_t max_frames, int64_t* consumed,
+                      int32_t* error) {
+  int n = 0;
+  int64_t pos = 0;
+  *error = 0;
+  while (len - pos >= 7 && n < max_frames) {
+    uint8_t type = buf[pos];
+    if (type != 1 && type != 2 && type != 3 && type != 8) {
+      *error = 1;
+      break;
+    }
+    uint32_t channel = (uint32_t(buf[pos + 1]) << 8) | buf[pos + 2];
+    uint32_t size = (uint32_t(buf[pos + 3]) << 24) |
+                    (uint32_t(buf[pos + 4]) << 16) |
+                    (uint32_t(buf[pos + 5]) << 8) | buf[pos + 6];
+    if (frame_max != 0 && uint64_t(size) + 8 > frame_max) {
+      *error = 2;
+      break;
+    }
+    int64_t end = pos + 7 + int64_t(size);
+    if (end + 1 > len) break;  // incomplete: wait for more bytes
+    if (buf[end] != 0xCE) {
+      *error = 3;
+      break;
+    }
+    types[n] = type;
+    channels[n] = int32_t(channel);
+    offsets[n] = pos + 7;
+    lengths[n] = int64_t(size);
+    ++n;
+    pos = end + 1;
+  }
+  *consumed = pos;
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// topic trie
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct TrieNode {
+  std::unordered_map<std::string, TrieNode*> children;
+  std::set<int32_t> queues;
+
+  ~TrieNode() {
+    for (auto& kv : children) delete kv.second;
+  }
+};
+
+struct Trie {
+  TrieNode root;
+  // (pattern, queue) registry for duplicate detection
+  std::set<std::pair<std::string, int32_t>> bindings;
+};
+
+void split_words(const char* key, std::vector<std::string>* out) {
+  const char* start = key;
+  const char* p = key;
+  for (;; ++p) {
+    if (*p == '.' || *p == '\0') {
+      out->emplace_back(start, p - start);
+      if (*p == '\0') break;
+      start = p + 1;
+    }
+  }
+}
+
+void walk(const TrieNode* node, const std::vector<std::string>& words,
+          size_t i, std::unordered_set<int32_t>* out) {
+  if (i == words.size()) {
+    out->insert(node->queues.begin(), node->queues.end());
+    // trailing '#' chains match zero remaining words
+    const TrieNode* tail = node;
+    for (;;) {
+      auto it = tail->children.find("#");
+      if (it == tail->children.end()) break;
+      tail = it->second;
+      out->insert(tail->queues.begin(), tail->queues.end());
+    }
+    return;
+  }
+  auto exact = node->children.find(words[i]);
+  if (exact != node->children.end()) walk(exact->second, words, i + 1, out);
+  auto star = node->children.find("*");
+  if (star != node->children.end()) walk(star->second, words, i + 1, out);
+  auto hash = node->children.find("#");
+  if (hash != node->children.end()) {
+    for (size_t j = i; j <= words.size(); ++j)
+      walk(hash->second, words, j, out);
+  }
+}
+
+}  // namespace
+
+void* chana_trie_new() { return new Trie(); }
+
+void chana_trie_free(void* handle) { delete static_cast<Trie*>(handle); }
+
+// returns 1 when the binding was added, 0 when it already existed
+int chana_trie_bind(void* handle, const char* pattern, int32_t queue_id) {
+  Trie* trie = static_cast<Trie*>(handle);
+  if (!trie->bindings.emplace(pattern, queue_id).second) return 0;
+  std::vector<std::string> words;
+  split_words(pattern, &words);
+  TrieNode* node = &trie->root;
+  for (const auto& word : words) {
+    TrieNode*& child = node->children[word];
+    if (child == nullptr) child = new TrieNode();
+    node = child;
+  }
+  node->queues.insert(queue_id);
+  return 1;
+}
+
+// returns 1 when the binding existed and was removed
+int chana_trie_unbind(void* handle, const char* pattern, int32_t queue_id) {
+  Trie* trie = static_cast<Trie*>(handle);
+  if (trie->bindings.erase({pattern, queue_id}) == 0) return 0;
+  std::vector<std::string> words;
+  split_words(pattern, &words);
+  // collect the path, then prune empty branches bottom-up (the reference's
+  // tomb/contract step, QueueMatcher.scala:283-347)
+  std::vector<std::pair<TrieNode*, std::string>> path;
+  TrieNode* node = &trie->root;
+  for (const auto& word : words) {
+    auto it = node->children.find(word);
+    if (it == node->children.end()) return 1;  // registry was authoritative
+    path.emplace_back(node, word);
+    node = it->second;
+  }
+  node->queues.erase(queue_id);
+  for (auto it = path.rbegin(); it != path.rend(); ++it) {
+    TrieNode* child = it->first->children[it->second];
+    if (!child->queues.empty() || !child->children.empty()) break;
+    it->first->children.erase(it->second);
+    delete child;
+  }
+  return 1;
+}
+
+// routes `key`; writes up to max_out queue ids; returns the match count
+int chana_trie_route(void* handle, const char* key, int32_t* out,
+                     int32_t max_out) {
+  Trie* trie = static_cast<Trie*>(handle);
+  std::vector<std::string> words;
+  split_words(key, &words);
+  std::unordered_set<int32_t> matches;
+  walk(&trie->root, words, 0, &matches);
+  int32_t n = 0;
+  for (int32_t id : matches) {
+    if (n >= max_out) break;
+    out[n++] = id;
+  }
+  return n;
+}
+
+int chana_trie_size(void* handle) {
+  return int(static_cast<Trie*>(handle)->bindings.size());
+}
+
+}  // extern "C"

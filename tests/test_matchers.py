@@ -124,7 +124,13 @@ def test_headers_any_match():
 
 
 def test_matcher_factory():
+    from chanamq_tpu import native_ext
+
     assert isinstance(matcher_for("direct"), DirectMatcher)
     assert isinstance(matcher_for("fanout"), FanoutMatcher)
-    assert isinstance(matcher_for("topic"), TopicMatcher)
+    topic = matcher_for("topic")
+    if native_ext.available():
+        assert isinstance(topic, native_ext.NativeTopicMatcher)
+    else:
+        assert isinstance(topic, TopicMatcher)
     assert isinstance(matcher_for("headers"), HeadersMatcher)
