@@ -86,11 +86,19 @@ def _param_names(cfg: ForecasterConfig) -> list[str]:
     return names
 
 
-def place(mesh: Mesh, params: Params, batch: Any):
-    """Device-put params/batch with their shardings (host -> mesh)."""
-    p_sharded = {
+def place_params(mesh: Mesh, params: Params) -> Params:
+    """Device-put a param tree with its shardings (host -> mesh)."""
+    return {
         name: jax.device_put(value, NamedSharding(mesh, _spec_for(name)))
         for name, value in params.items()
     }
-    b_sharded = tuple(jax.device_put(part, batch_sharding(mesh)) for part in batch)
-    return p_sharded, b_sharded
+
+
+def place_batch(mesh: Mesh, batch: Any):
+    """Device-put a (x, y) batch tuple dp-sharded on the leading axis."""
+    return tuple(jax.device_put(part, batch_sharding(mesh)) for part in batch)
+
+
+def place(mesh: Mesh, params: Params, batch: Any):
+    """Device-put params/batch with their shardings (host -> mesh)."""
+    return place_params(mesh, params), place_batch(mesh, batch)
