@@ -47,13 +47,22 @@ SPECS = {
     "persistent_ack_3p1c": (False, True, 3, 1),
 }
 
+# Paced-load latency spec: the saturated specs above measure queueing delay
+# by construction (a full confirm window IS hundreds of ms of in-flight
+# messages), so broker latency is measured separately under a fixed-rate
+# load well below capacity. The rate is derived from the measured headline
+# (~25% of saturated throughput) or BENCH_PACED_RATE.
+PACED_SPEC = "paced_latency_1p1c"
+
 
 # ---------------------------------------------------------------------------
 # child roles
 # ---------------------------------------------------------------------------
 
 
-async def producer_main(port: int, persistent: bool, seconds: float) -> None:
+async def producer_main(
+    port: int, persistent: bool, seconds: float, rate: int = 0
+) -> None:
     from chanamq_tpu.amqp.properties import BasicProperties
     from chanamq_tpu.client import AMQPClient
 
@@ -64,15 +73,34 @@ async def producer_main(port: int, persistent: bool, seconds: float) -> None:
     pad = b"x" * (BODY_BYTES - 8)
     deadline = time.perf_counter() + seconds
     published = 0
-    while time.perf_counter() < deadline:
-        body = time.time_ns().to_bytes(8, "big") + pad
-        ch.basic_publish(body, exchange="bench_ex", routing_key="bench",
-                         properties=props)
-        published += 1
-        if len(ch.unconfirmed) >= CONFIRM_WINDOW:
-            await c.writer.drain()
-            await ch.wait_unconfirmed_below(CONFIRM_WINDOW // 2)
-    await c.writer.drain()
+    if rate > 0:
+        # fixed-rate pacing in 10 ms micro-bursts (PerfTest --rate shape)
+        burst = max(1, rate // 100)
+        next_t = time.perf_counter()
+        while time.perf_counter() < deadline:
+            for _ in range(burst):
+                body = time.time_ns().to_bytes(8, "big") + pad
+                ch.basic_publish(body, exchange="bench_ex",
+                                 routing_key="bench", properties=props)
+                published += 1
+            next_t += burst / rate
+            delay = next_t - time.perf_counter()
+            if delay > 0:
+                await c.drain()
+                await asyncio.sleep(delay)
+            if len(ch.unconfirmed) >= CONFIRM_WINDOW:
+                await c.drain()
+                await ch.wait_unconfirmed_below(CONFIRM_WINDOW // 2)
+    else:
+        while time.perf_counter() < deadline:
+            body = time.time_ns().to_bytes(8, "big") + pad
+            ch.basic_publish(body, exchange="bench_ex", routing_key="bench",
+                             properties=props)
+            published += 1
+            if len(ch.unconfirmed) >= CONFIRM_WINDOW:
+                await c.drain()
+                await ch.wait_unconfirmed_below(CONFIRM_WINDOW // 2)
+    await c.drain()
     try:
         await ch.wait_unconfirmed_below(1, timeout=15)
     except asyncio.TimeoutError:
@@ -152,8 +180,11 @@ async def setup_topology(port: int, persistent: bool) -> None:
     await c.close()
 
 
-def run_spec(name: str) -> dict:
-    auto_ack, persistent, producers, consumers = SPECS[name]
+def run_spec(name: str, rate: int = 0) -> dict:
+    if name == PACED_SPEC:
+        auto_ack, persistent, producers, consumers = True, False, 1, 1
+    else:
+        auto_ack, persistent, producers, consumers = SPECS[name]
     port = free_port()
     env = {**os.environ, "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))}
     broker_args = [sys.executable, "-m", "chanamq_tpu.broker.server",
@@ -184,7 +215,7 @@ def run_spec(name: str) -> dict:
             children.append(subprocess.Popen(
                 [sys.executable, __file__, "--role", "producer",
                  "--port", str(port), "--persistent", str(int(persistent)),
-                 "--seconds", str(BENCH_SECONDS)],
+                 "--seconds", str(BENCH_SECONDS), "--rate", str(rate)],
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL))
         outputs = []
         for child in children:
@@ -224,26 +255,47 @@ def main() -> None:
         parser.add_argument("--auto-ack", type=int, default=1)
         parser.add_argument("--persistent", type=int, default=0)
         parser.add_argument("--seconds", type=float, default=5)
+        parser.add_argument("--rate", type=int, default=0)
         args = parser.parse_args()
         if args.role == "producer":
-            asyncio.run(producer_main(args.port, bool(args.persistent), args.seconds))
+            asyncio.run(producer_main(
+                args.port, bool(args.persistent), args.seconds, args.rate))
         else:
             asyncio.run(consumer_main(args.port, bool(args.auto_ack), args.seconds))
         return
 
-    which = os.environ.get("BENCH_SPECS", "a")
-    names = list(SPECS) if which == "all" else ["transient_autoack_3p3c"]
+    which = os.environ.get("BENCH_SPECS", "all")
+    if which == "a":
+        names = ["transient_autoack_3p3c"]
+    elif which == "all":
+        names = list(SPECS)
+    else:
+        names = [n.strip() for n in which.split(",") if n.strip() in SPECS]
+        if not names:
+            print(f"# BENCH_SPECS={which!r} matched no spec; running all",
+                  file=sys.stderr)
+            names = list(SPECS)
     results = {}
     for name in names:
         results[name] = run_spec(name)
         print(f"# {name}: {results[name]}", file=sys.stderr)
     headline = results[names[0]]
+    if which != "a":
+        # paced latency run at ~25% of the measured headline throughput
+        paced_rate = int(os.environ.get(
+            "BENCH_PACED_RATE",
+            max(1000, int(headline["delivered_per_s"] * 0.25))))
+        results[PACED_SPEC] = run_spec(PACED_SPEC, rate=paced_rate)
+        results[PACED_SPEC]["rate"] = paced_rate
+        print(f"# {PACED_SPEC}: {results[PACED_SPEC]}", file=sys.stderr)
     line = {
         "metric": "amqp_delivered_msgs_per_s_transient_autoack_3p3c",
         "value": headline["delivered_per_s"],
         "unit": "msgs/s",
         "vs_baseline": None,  # reference published no numbers (BASELINE.md)
         "p99_publish_to_deliver_us": headline["p99_us"],
+        "paced_p50_us": results.get(PACED_SPEC, {}).get("p50_us"),
+        "paced_p99_us": results.get(PACED_SPEC, {}).get("p99_us"),
         "body_bytes": BODY_BYTES,
         "seconds": BENCH_SECONDS,
         "specs": results,

@@ -27,14 +27,22 @@ class AMQCommand:
     method: Method
     properties: Optional[BasicProperties] = None
     body: bytes = b""
+    # Raw HEADER-frame payload as received off the wire (class-id + weight +
+    # body-size + property flags/values). Kept so re-rendering the same
+    # content (delivery of a just-published message, mandatory returns,
+    # persistence) skips the property re-encode — the bytes are identical.
+    header_raw: Optional[bytes] = None
 
     def render_frames(self, frame_max: int) -> list[Frame]:
         if frame_max and frame_max <= FRAME_OVERHEAD:
             raise ValueError(f"frame_max {frame_max} leaves no room for payload")
         frames = [Frame.method(self.channel, self.method.encode())]
         if self.method.HAS_CONTENT:
-            props = self.properties or BasicProperties()
-            frames.append(Frame.header(self.channel, props.encode_header(len(self.body))))
+            header_payload = self.header_raw
+            if header_payload is None:
+                props = self.properties or BasicProperties()
+                header_payload = props.encode_header(len(self.body))
+            frames.append(Frame.header(self.channel, header_payload))
             body = self.body
             max_payload = (frame_max - FRAME_OVERHEAD) if frame_max else max(len(body), 1)
             for off in range(0, len(body), max_payload):
@@ -93,6 +101,7 @@ class CommandAssembler:
                 yield FrameError(ErrorCode.SYNTAX_ERROR, f"bad content header: {exc}")
                 return
             partial.command.properties = props
+            partial.command.header_raw = frame.payload
             partial.expected_size = body_size
             if body_size == 0:
                 del self._partial[channel]

@@ -123,10 +123,22 @@ class BasicProperties:
 
     @staticmethod
     def decode_header(payload: bytes) -> tuple[int, int, "BasicProperties"]:
-        """Decode a HEADER-frame payload -> (class_id, body_size, properties)."""
+        """Decode a HEADER-frame payload -> (class_id, body_size, properties).
+
+        Hot loop: the two overwhelmingly common property shapes — no
+        properties, and delivery-mode only — decode without the generic
+        flag-walk."""
+        if len(payload) < 14:
+            raise ValueError("content header shorter than 14 bytes")
+        class_id = (payload[0] << 8) | payload[1]
+        body_size = int.from_bytes(payload[4:12], "big")
+        flags = (payload[12] << 8) | payload[13]
+        if flags == 0 and len(payload) == 14:
+            return class_id, body_size, BasicProperties()
+        if flags == 0x1000 and len(payload) == 15:  # delivery-mode only
+            return class_id, body_size, BasicProperties(delivery_mode=payload[14])
         stream = BytesIO(payload)
-        class_id, _weight = struct.unpack(">HH", stream.read(4))
-        (body_size,) = struct.unpack(">Q", stream.read(8))
+        stream.seek(12)
         props = BasicProperties.read_properties(stream)
         return class_id, body_size, props
 

@@ -58,6 +58,7 @@ class Broker:
         self.message_sweep_interval_s = message_sweep_interval_s
         self._sweep_task: Optional[asyncio.Task] = None
         self._bg_tasks: set[asyncio.Task] = set()
+        self._msg_delete_buf: list[int] = []
         self._started = False
 
     # -- lifecycle ---------------------------------------------------------
@@ -75,21 +76,27 @@ class Broker:
         if self._sweep_task:
             self._sweep_task.cancel()
             self._sweep_task = None
+        self._flush_msg_deletes()
+        for vhost in self.vhosts.values():
+            for queue in vhost.queues.values():
+                queue.flush_store_buffers()
         # let queued background store writes drain before closing
         if self._bg_tasks:
             await asyncio.gather(*self._bg_tasks, return_exceptions=True)
         await self.store.close()
         self._started = False
 
-    def store_bg(self, coro: Awaitable[None]) -> None:
-        """Fire-and-forget store write. Ordering: tasks are created in call
-        order and each store op's first await is its executor submit, so the
-        single writer thread executes them FIFO."""
-        task = asyncio.get_event_loop().create_task(coro)  # type: ignore[arg-type]
+    def store_bg(self, aw: Awaitable[None]) -> None:
+        """Fire-and-forget store write. The SQLite backend enqueues ops
+        synchronously at call time (group-commit queue), so program order ==
+        store order; this wrapper only tracks completion and logs failures.
+        MemoryStore coroutines are wrapped into tasks (created in call order,
+        still FIFO)."""
+        task = asyncio.ensure_future(aw)  # type: ignore[arg-type]
         self._bg_tasks.add(task)
         task.add_done_callback(self._bg_done)
 
-    def _bg_done(self, task: asyncio.Task) -> None:
+    def _bg_done(self, task: "asyncio.Future") -> None:
         self._bg_tasks.discard(task)
         if not task.cancelled() and task.exception():
             log.error("background store write failed: %r", task.exception())
@@ -208,6 +215,7 @@ class Broker:
         return Message(
             stored.id, props, stored.body, stored.exchange,
             stored.routing_key, stored.ttl_ms,
+            header_raw=stored.properties_raw,
         )
 
     # -- vhosts ------------------------------------------------------------
@@ -533,12 +541,15 @@ class Broker:
         *,
         mandatory: bool = False,
         immediate: bool = False,
+        header_raw: Optional[bytes] = None,
     ) -> tuple[bool, bool]:
         """Route one message. Returns (routed, deliverable):
         routed=False    -> mandatory handling applies,
         deliverable=False (with immediate) -> immediate handling applies.
-        Durability: awaited store writes happen before return, so a confirm
-        sent after this implies persistence."""
+        Durability: persistent writes (message blob + queue-log residency)
+        are ENQUEUED in order before return; callers that promise durability
+        (publisher confirms, cluster push replies) must await
+        ``self.store.flush()`` — the group-commit barrier — before doing so."""
         vhost = self.vhost(vhost_name)
         exchange = vhost.exchanges.get(exchange_name)
         if exchange is None:
@@ -560,26 +571,30 @@ class Broker:
         if self.cluster is not None:
             return await self._publish_clustered(
                 vhost, exchange_name, routing_key, properties, body,
-                queue_names, mandatory=mandatory, immediate=immediate)
+                queue_names, mandatory=mandatory, immediate=immediate,
+                header_raw=header_raw)
         queues = [vhost.queues[qn] for qn in queue_names if qn in vhost.queues]
         if not queues:
             return (False, True)
         message = Message(
             self.idgen.next_id(), properties, body, exchange_name, routing_key,
-            properties.expiration_ms(),
+            properties.expiration_ms(), header_raw=header_raw,
         )
         message.refer_count = len(queues)
         # persistence decision (reference: ExchangeEntity.scala:302):
         # message persistent AND at least one routed queue durable
         persist = message.is_persistent and any(q.durable for q in queues)
         if persist:
+            # enqueue (not await): the queue-log rows from queue.push() below
+            # land in the SAME group-commit batch, so one commit covers the
+            # message blob and all its residencies.
             message.persisted = True
-            await self.store.insert_message(StoredMessage(
+            self.store_bg(self.store.insert_message(StoredMessage(
                 id=message.id,
-                properties_raw=properties.encode_header(len(body)),
+                properties_raw=message.header_payload(),
                 body=body, exchange=exchange_name, routing_key=routing_key,
                 refer_count=len(queues), ttl_ms=message.ttl_ms,
-            ))
+            )))
         deliverable = True
         if immediate:
             deliverable = any(
@@ -596,6 +611,7 @@ class Broker:
         self, vhost: VHost, exchange_name: str, routing_key: str,
         properties: BasicProperties, body: bytes, queue_names: set[str],
         *, mandatory: bool, immediate: bool,
+        header_raw: Optional[bytes] = None,
     ) -> tuple[bool, bool]:
         """Cluster publish: routing already happened locally on the
         replicated exchange metadata; per-owner queue.push RPCs carry the
@@ -621,7 +637,8 @@ class Broker:
                 by_owner.setdefault(owner, []).append(name)
         if not local and not by_owner:
             return (False, True)
-        props_raw = properties.encode_header(len(body))
+        props_raw = header_raw if header_raw is not None \
+            else properties.encode_header(len(body))
         had_consumer = any(
             any(c.can_take(len(body)) for c in q.consumers) for q in local
         )
@@ -655,15 +672,15 @@ class Broker:
         if local:
             message = Message(
                 self.idgen.next_id(), properties, body, exchange_name,
-                routing_key, properties.expiration_ms())
+                routing_key, properties.expiration_ms(), header_raw=props_raw)
             message.refer_count = len(local)
             persist = message.is_persistent and any(q.durable for q in local)
             if persist:
                 message.persisted = True
-                await self.store.insert_message(StoredMessage(
+                self.store_bg(self.store.insert_message(StoredMessage(
                     id=message.id, properties_raw=props_raw, body=body,
                     exchange=exchange_name, routing_key=routing_key,
-                    refer_count=len(local), ttl_ms=message.ttl_ms))
+                    refer_count=len(local), ttl_ms=message.ttl_ms)))
             for queue in local:
                 queue.push(message)
         return (True, True)
@@ -677,7 +694,18 @@ class Broker:
         message.refer_count -= n
         if message.refer_count <= 0 and message.persisted:
             message.persisted = False
-            self.store_bg(self.store.delete_message(message.id))
+            # coalesce per loop tick: one executemany instead of a store op
+            # per message (ids are snowflakes, never reused, so a delayed
+            # delete can't clash with a later insert)
+            buf = self._msg_delete_buf
+            buf.append(message.id)
+            if len(buf) == 1:
+                asyncio.get_event_loop().call_soon(self._flush_msg_deletes)
+
+    def _flush_msg_deletes(self) -> None:
+        ids, self._msg_delete_buf = self._msg_delete_buf, []
+        if ids:
+            self.store_bg(self.store.delete_messages(ids))
 
     # -- TTL sweep ---------------------------------------------------------
 

@@ -243,20 +243,30 @@ class AMQPConnection:
                             exc.code, exc.text, exc.class_id, exc.method_id)
                         return
                     except ChannelError as exc:
-                        self._soft_close_channel(out.channel, exc)
+                        await self._soft_close_channel(out.channel, exc)
                     except BrokerError as exc:
                         if exc.code.is_hard_error:
                             await self._hard_close(
                                 exc.code, exc.text,
                                 out.method.CLASS_ID, out.method.METHOD_ID)
                             return
-                        self._soft_close_channel(
+                        await self._soft_close_channel(
                             out.channel,
                             ChannelError(exc.code, exc.text,
                                          out.method.CLASS_ID, out.method.METHOD_ID))
                     if self.closing:
                         return
+            await self._confirm_barrier()
             self._flush_confirms()
+
+    async def _confirm_barrier(self) -> None:
+        """Durability barrier before releasing publisher confirms: a confirm
+        may only reach the client once the store has committed every write
+        the confirmed publishes enqueued (message blob + queue-log rows —
+        all in one group-commit batch). Free for transient traffic: flush()
+        returns an already-done future when nothing is pending."""
+        if self._pending_confirms:
+            await self.broker.store.flush()
 
     def _flush_confirms(self) -> None:
         if not self._pending_confirms:
@@ -274,6 +284,7 @@ class AMQPConnection:
     async def _hard_close(
         self, code: ErrorCode, text: str, class_id: int = 0, method_id: int = 0
     ) -> None:
+        await self._confirm_barrier()
         self._flush_confirms()
         if not self.closing:
             self.send_method(0, am.Connection.Close(
@@ -282,9 +293,10 @@ class AMQPConnection:
             ))
         self.closing = True
 
-    def _soft_close_channel(self, channel_id: int, exc: ChannelError) -> None:
+    async def _soft_close_channel(self, channel_id: int, exc: ChannelError) -> None:
         """Channel exception: close just the channel (reference behavior for
         404/405/406 soft errors)."""
+        await self._confirm_barrier()
         self._flush_confirms()
         self._pending_confirms.pop(channel_id, None)
         channel = self.channels.pop(channel_id, None)
@@ -444,6 +456,7 @@ class AMQPConnection:
         elif isinstance(method, am.Connection.Close):
             # confirms for publishes pipelined ahead of the close must still
             # reach the client before close-ok
+            await self._confirm_barrier()
             self._flush_confirms()
             self.send_method(0, am.Connection.CloseOk())
             self.closing = True
@@ -493,6 +506,7 @@ class AMQPConnection:
         elif isinstance(method, am.Channel.FlowOk):
             pass
         elif isinstance(method, am.Channel.Close):
+            await self._confirm_barrier()
             self._flush_confirms()
             self._pending_confirms.pop(cid, None)
             channel = self.channels.pop(cid, None)
@@ -711,6 +725,7 @@ class AMQPConnection:
             self.vhost_name, method.exchange, method.routing_key,
             props, command.body,
             mandatory=method.mandatory, immediate=method.immediate,
+            header_raw=command.header_raw,
         )
         if not routed and method.mandatory:
             self.broker.metrics.returned_msgs += 1
@@ -719,7 +734,7 @@ class AMQPConnection:
                 am.Basic.Return(
                     reply_code=int(ErrorCode.NO_ROUTE), reply_text="NO_ROUTE",
                     exchange=method.exchange, routing_key=method.routing_key),
-                props, command.body))
+                props, command.body, header_raw=command.header_raw))
         elif not deliverable and method.immediate:
             self.broker.metrics.returned_msgs += 1
             self.send_command(AMQCommand(
@@ -727,7 +742,7 @@ class AMQPConnection:
                 am.Basic.Return(
                     reply_code=int(ErrorCode.NO_CONSUMERS), reply_text="NO_CONSUMERS",
                     exchange=method.exchange, routing_key=method.routing_key),
-                props, command.body))
+                props, command.body, header_raw=command.header_raw))
         if seq is not None:
             # coalesce: publish seqs are contiguous per channel and commands
             # are processed in order, so one Basic.Ack(multiple=true) with the

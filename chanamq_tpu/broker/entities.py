@@ -46,7 +46,7 @@ class Message:
 
     __slots__ = (
         "id", "properties", "body", "exchange", "routing_key",
-        "ttl_ms", "refer_count", "persisted", "published_ns",
+        "ttl_ms", "refer_count", "persisted", "published_ns", "header_raw",
     )
 
     def __init__(
@@ -57,6 +57,7 @@ class Message:
         exchange: str,
         routing_key: str,
         ttl_ms: Optional[int] = None,
+        header_raw: Optional[bytes] = None,
     ) -> None:
         self.id = id
         self.properties = properties
@@ -67,6 +68,16 @@ class Message:
         self.refer_count = 0
         self.persisted = False
         self.published_ns = time.perf_counter_ns()
+        # wire-format content-header payload; rendered lazily when absent
+        # and reused for every delivery + the persisted blob
+        self.header_raw = header_raw
+
+    def header_payload(self) -> bytes:
+        hp = self.header_raw
+        if hp is None:
+            hp = self.properties.encode_header(len(self.body))
+            self.header_raw = hp
+        return hp
 
     @property
     def is_persistent(self) -> bool:
@@ -145,6 +156,9 @@ class Queue:
         self.had_consumer = False  # auto-delete arms only after first consumer
         self.deleted = False
         self._dispatch_scheduled = False
+        # per-tick store-write coalescing (hot delivery/ack paths)
+        self._wm_dirty = False  # a watermark persist is scheduled
+        self._unack_del_buf: list[int] = []
 
     # -- introspection ----------------------------------------------------
 
@@ -203,12 +217,28 @@ class Queue:
     def _advance_watermark(self, qm: QueuedMessage) -> None:
         if qm.offset > self.last_consumed:
             self.last_consumed = qm.offset
-            if self.durable:
-                self.broker.store_bg(
-                    self.broker.store.update_queue_last_consumed(
-                        self.vhost, self.name, self.last_consumed
-                    )
-                )
+            if self.durable and not self._wm_dirty:
+                # coalesce: one persisted watermark write per loop tick, with
+                # the value re-read at flush time (covers every advance and
+                # any requeue rewind in between)
+                self._wm_dirty = True
+                asyncio.get_event_loop().call_soon(self._persist_watermark)
+
+    def _persist_watermark(self) -> None:
+        self._wm_dirty = False
+        if self.deleted:
+            return
+        self.broker.store_bg(
+            self.broker.store.update_queue_last_consumed(
+                self.vhost, self.name, self.last_consumed
+            )
+        )
+
+    def flush_store_buffers(self) -> None:
+        """Flush per-tick coalescing buffers now (shutdown path)."""
+        if self._wm_dirty:
+            self._persist_watermark()
+        self._flush_unack_deletes()
 
     def schedule_dispatch(self) -> None:
         if self._dispatch_scheduled or self.deleted:
@@ -275,12 +305,18 @@ class Queue:
     def ack(self, delivery: Delivery) -> None:
         self.outstanding.pop(delivery.queued.offset, None)
         if self.durable and delivery.queued.message.persisted:
-            self.broker.store_bg(
-                self.broker.store.delete_queue_unacks(
-                    self.vhost, self.name, [delivery.queued.message.id]
-                )
-            )
+            buf = self._unack_del_buf
+            buf.append(delivery.queued.message.id)
+            if len(buf) == 1:
+                asyncio.get_event_loop().call_soon(self._flush_unack_deletes)
         self.broker.unrefer(delivery.queued.message)
+
+    def _flush_unack_deletes(self) -> None:
+        ids, self._unack_del_buf = self._unack_del_buf, []
+        if ids and not self.deleted:
+            self.broker.store_bg(
+                self.broker.store.delete_queue_unacks(self.vhost, self.name, ids)
+            )
 
     def drop(self, delivery: Delivery) -> None:
         """Reject without requeue: same store cleanup as ack."""

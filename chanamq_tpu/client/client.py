@@ -11,14 +11,32 @@ from __future__ import annotations
 
 import asyncio
 import ssl as ssl_module
+import struct
 from dataclasses import dataclass
+from io import BytesIO
 from typing import Any, Awaitable, Callable, Optional, Union
 
 from ..amqp.command import AMQCommand, CommandAssembler
-from ..amqp.constants import FrameType, PROTOCOL_HEADER
+from ..amqp.constants import FRAME_OVERHEAD, FrameType, PROTOCOL_HEADER
 from ..amqp.frame import Frame, FrameError, FrameParser, HEARTBEAT_BYTES
 from ..amqp import methods as am
 from ..amqp.properties import BasicProperties
+
+_FRAME_HDR = struct.Struct(">BHI").pack
+
+
+def _parse_deliver_fields(payload: bytes) -> tuple[str, int, bool, str, str]:
+    """Hand-parse a basic.deliver method payload (past the 4 id bytes)."""
+    pos = 4
+    n = payload[pos]; pos += 1
+    consumer_tag = payload[pos:pos + n].decode("utf-8"); pos += n
+    delivery_tag = int.from_bytes(payload[pos:pos + 8], "big"); pos += 8
+    redelivered = bool(payload[pos] & 1); pos += 1
+    n = payload[pos]; pos += 1
+    exchange = payload[pos:pos + n].decode("utf-8"); pos += n
+    n = payload[pos]; pos += 1
+    routing_key = payload[pos:pos + n].decode("utf-8")
+    return consumer_tag, delivery_tag, redelivered, exchange, routing_key
 
 
 class AMQPClientError(Exception):
@@ -70,8 +88,17 @@ class AMQPClient:
     def __init__(self) -> None:
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
-        self._parser = FrameParser()
+        from .. import native_ext
+
+        if native_ext.available():
+            self._parser: FrameParser = native_ext.NativeFrameParser()  # type: ignore[assignment]
+        else:
+            self._parser = FrameParser()
         self._assembler = CommandAssembler()
+        # outbound coalescing: sends buffer here and flush once per loop
+        # tick (one syscall per batch instead of per method/publish)
+        self._wparts: list[bytes] = []
+        self._wflush_scheduled = False
         self.channels: dict[int, "ClientChannel"] = {}
         self._next_channel = 1
         self._free_channel_ids: list[int] = []
@@ -144,6 +171,7 @@ class AMQPClient:
     async def _shutdown(self, exc: Optional[Exception]) -> None:
         if self.closed:
             return
+        self._flush_writes()  # e.g. a pending CloseOk reply
         self.closed = True
         self._close_exc = exc
         if self._heartbeat_task:
@@ -188,16 +216,40 @@ class AMQPClient:
 
     # -- wire I/O ----------------------------------------------------------
 
+    def _write(self, data: bytes) -> None:
+        """Buffer outbound bytes; flushed once per event-loop tick."""
+        self._wparts.append(data)
+        if not self._wflush_scheduled:
+            self._wflush_scheduled = True
+            asyncio.get_event_loop().call_soon(self._flush_writes)
+
+    def _flush_writes(self) -> None:
+        self._wflush_scheduled = False
+        if self._wparts and self.writer is not None and not self.closed:
+            data = b"".join(self._wparts)
+            self._wparts.clear()
+            try:
+                self.writer.write(data)
+            except Exception:
+                pass  # reader loop surfaces the connection error
+
+    async def drain(self) -> None:
+        """Flush the coalescing buffer and wait for the transport."""
+        self._flush_writes()
+        if self.writer is not None:
+            await self.writer.drain()
+
     def _send_method(self, channel: int, method: am.Method) -> None:
-        assert self.writer is not None
-        self.writer.write(Frame.method(channel, method.encode()).to_bytes())
+        self._write(Frame.method(channel, method.encode()).to_bytes())
 
     def _send_command(self, command: AMQCommand) -> None:
-        assert self.writer is not None
-        self.writer.write(command.render(self.frame_max))
+        self._write(command.render(self.frame_max))
 
     async def _read_loop(self) -> None:
         assert self.reader is not None
+        # fast-path state for in-flight basic.deliver content, per channel:
+        # [fields_tuple, props, body_size, chunks, received]
+        fast_partial: dict[int, list] = {}
         try:
             while True:
                 data = await self.reader.read(65536)
@@ -209,7 +261,39 @@ class AMQPClient:
                         await self._shutdown(
                             ConnectionClosedError(int(item.code), item.message))
                         return
-                    if item.type == FrameType.HEARTBEAT:
+                    ftype = item.type
+                    cid = item.channel
+                    payload = item.payload
+                    # -- basic.deliver fast path: per AMQP 0-9-1 §4.2.6
+                    # content frames are never interleaved with other frames
+                    # on the SAME channel, so a tiny inline state machine can
+                    # own the method->header->body sequence and skip the
+                    # generic assembler + Method object entirely.
+                    if ftype == FrameType.METHOD:
+                        if payload[:4] == b"\x00\x3c\x00\x3c" and cid not in fast_partial:
+                            fast_partial[cid] = [
+                                _parse_deliver_fields(payload), None, 0, [], 0]
+                            continue
+                    elif cid in fast_partial:
+                        partial = fast_partial[cid]
+                        if ftype == FrameType.HEADER:
+                            _, body_size, props = BasicProperties.decode_header(payload)
+                            partial[1] = props
+                            partial[2] = body_size
+                            if body_size == 0:
+                                del fast_partial[cid]
+                                await self._deliver_fast(cid, partial, b"")
+                            continue
+                        if ftype == FrameType.BODY:
+                            partial[3].append(payload)
+                            partial[4] += len(payload)
+                            if partial[4] >= partial[2]:
+                                del fast_partial[cid]
+                                chunks = partial[3]
+                                body = chunks[0] if len(chunks) == 1 else b"".join(chunks)
+                                await self._deliver_fast(cid, partial, body)
+                            continue
+                    if ftype == FrameType.HEARTBEAT:
                         continue
                     for out in self._assembler.feed(item):
                         if isinstance(out, FrameError):
@@ -221,6 +305,24 @@ class AMQPClient:
             pass
         except Exception as exc:
             await self._shutdown(exc)
+
+    async def _deliver_fast(self, cid: int, partial: list, body: bytes) -> None:
+        consumer_tag, delivery_tag, redelivered, exchange, routing_key = partial[0]
+        channel = self.channels.get(cid)
+        if channel is None:
+            return
+        msg = DeliveredMessage(
+            consumer_tag=consumer_tag, delivery_tag=delivery_tag,
+            redelivered=redelivered, exchange=exchange,
+            routing_key=routing_key, properties=partial[1], body=body,
+        )
+        callback = channel._consumers.get(consumer_tag)
+        if callback is not None:
+            result = callback(msg)
+            if result is not None and asyncio.iscoroutine(result):
+                await result
+        else:
+            channel._pending_deliveries.setdefault(consumer_tag, []).append(msg)
 
     async def _on_command(self, command: AMQCommand) -> None:
         method = command.method
@@ -276,6 +378,14 @@ class ClientChannel:
         self._confirm_waiters: dict[int, asyncio.Future] = {}
         self.unconfirmed: set[int] = set()
         self._confirm_event = asyncio.Event()
+        # publish template cache: (exchange, routing_key, mandatory,
+        # immediate, id(props)) -> (props_ref, props_snapshot, method_frame,
+        # props_payload). The snapshot (a copy taken at encode time) is
+        # compared against the live object on every hit, so mutating a
+        # reused props object between publishes re-encodes instead of
+        # silently sending stale bytes; the ref also pins the id against
+        # allocator recycling.
+        self._publish_cache: dict[tuple, tuple] = {}
 
     # -- RPC plumbing ------------------------------------------------------
 
@@ -510,15 +620,55 @@ class ClientChannel:
         properties: Optional[BasicProperties] = None,
         mandatory: bool = False, immediate: bool = False,
     ) -> Optional[int]:
-        """Fire-and-forget publish. In confirm mode returns the seq number."""
-        self.client._send_command(AMQCommand(
-            self.id,
-            am.Basic.Publish(
+        """Fire-and-forget publish. In confirm mode returns the seq number.
+
+        Hot loop: the method frame and encoded properties are cached per
+        (exchange, routing-key, flags, properties object) — republishing
+        with the same arguments only re-frames the header (body size varies)
+        and the body."""
+        key = (exchange, routing_key, mandatory, immediate, id(properties))
+        entry = self._publish_cache.get(key)
+        if entry is not None and properties is not None \
+                and entry[1] != properties:
+            entry = None  # props object mutated since it was cached
+        if entry is None:
+            props = properties or BasicProperties()
+            method_payload = am.Basic.Publish(
                 exchange=exchange, routing_key=routing_key,
-                mandatory=mandatory, immediate=immediate),
-            properties or BasicProperties(),
-            body,
-        ))
+                mandatory=mandatory, immediate=immediate).encode()
+            method_frame = (
+                _FRAME_HDR(1, self.id, len(method_payload))
+                + method_payload + b"\xce")
+            props_out = BytesIO()
+            props.write_properties(props_out)
+            if len(self._publish_cache) >= 256:
+                self._publish_cache.clear()
+            entry = (properties, props.copy(), method_frame,
+                     props_out.getvalue())
+            self._publish_cache[key] = entry
+        method_frame, props_payload = entry[2], entry[3]
+        header_payload_len = 12 + len(props_payload)
+        cid = self.id
+        parts = [
+            method_frame,
+            _FRAME_HDR(2, cid, header_payload_len),
+            b"\x00\x3c\x00\x00",  # class 60 (basic), weight 0
+            len(body).to_bytes(8, "big"),
+            props_payload,
+            b"\xce",
+        ]
+        if body:
+            frame_max = self.client.frame_max
+            max_payload = (frame_max - FRAME_OVERHEAD) if frame_max else len(body)
+            if len(body) <= max_payload:
+                parts += (_FRAME_HDR(3, cid, len(body)), body, b"\xce")
+            else:
+                for off in range(0, len(body), max_payload):
+                    chunk = body[off:off + max_payload]
+                    parts += (_FRAME_HDR(3, cid, len(chunk)), chunk, b"\xce")
+        if self.closed:
+            raise self.close_reason or ChannelClosedError(0, "closed")
+        self.client._write(b"".join(parts))
         if self.confirm_mode:
             self._publish_seq += 1
             self.unconfirmed.add(self._publish_seq)

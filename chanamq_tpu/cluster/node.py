@@ -490,7 +490,7 @@ class ClusterNode:
             message = Message(
                 self.broker.idgen.next_id(), props, body,
                 str(payload["exchange"]), str(payload["routing_key"]),
-                props.expiration_ms(),
+                props.expiration_ms(), header_raw=bytes(payload["props_raw"]),
             )
             message.refer_count = len(queues)
             persist = message.is_persistent and any(q.durable for q in queues)
@@ -498,14 +498,18 @@ class ClusterNode:
                 message.persisted = True
                 from ..store.api import StoredMessage
 
-                await self.broker.store.insert_message(StoredMessage(
+                self.broker.store_bg(self.broker.store.insert_message(StoredMessage(
                     id=message.id, properties_raw=bytes(payload["props_raw"]),
                     body=body, exchange=message.exchange,
                     routing_key=message.routing_key,
                     refer_count=len(queues), ttl_ms=message.ttl_ms,
-                ))
+                )))
             for queue in queues:
                 queue.push(message)
+            if persist:
+                # the reply releases the origin's confirm: barrier on the
+                # group commit covering the blob + queue-log rows above
+                await self.broker.store.flush()
         return {"pushed": bool(queues), "had_consumer": had_consumer}
 
     async def _h_queue_get(self, payload: dict) -> dict:

@@ -121,10 +121,17 @@ class NativeFrameParser:
     def feed(self, data: bytes) -> Iterator[Frame | FrameError]:
         if self._dead:
             return
-        buf = self._buf
-        buf += data
+        # One buffer->bytes conversion per feed() call (NOT per scan pass —
+        # a per-pass copy would be O(n^2) when a backlog accumulates); the
+        # rare >_MAX_FRAMES_PER_SCAN continuation slices off the consumed
+        # prefix, amortized O(1) per byte.
+        if self._buf:
+            self._buf += data
+            raw = bytes(self._buf)
+            self._buf = bytearray()
+        else:
+            raw = bytes(data)
         while True:
-            raw = bytes(buf)
             n = self._lib.chana_scan_frames(
                 raw, len(raw), self.frame_max,
                 self._types, self._channels, self._offsets, self._lengths,
@@ -135,7 +142,7 @@ class NativeFrameParser:
                 yield Frame(
                     self._types[i], self._channels[i],
                     raw[off : off + self._lengths[i]])
-            del buf[: self._consumed.value]
+            consumed = self._consumed.value
             error = self._error.value
             if error:
                 self._dead = True
@@ -151,7 +158,10 @@ class NativeFrameParser:
                                      "missing frame-end octet")
                 return
             if n < _MAX_FRAMES_PER_SCAN:
+                if consumed < len(raw):
+                    self._buf = bytearray(raw[consumed:])
                 return
+            raw = raw[consumed:]
 
 
 class NativeTopicMatcher(Matcher):

@@ -11,8 +11,15 @@ CassandraOpService.scala:753-755 — a scar SURVEY.md §7.3 says to avoid).
 
 from __future__ import annotations
 
+import asyncio
 from dataclasses import dataclass, field
 from typing import Any, Optional
+
+
+def _done_future() -> "asyncio.Future[None]":
+    fut: asyncio.Future = asyncio.get_event_loop().create_future()
+    fut.set_result(None)
+    return fut
 
 
 @dataclass(slots=True)
@@ -64,6 +71,12 @@ class StoreService:
 
     async def close(self) -> None: ...
 
+    def flush(self):
+        """Durability barrier: awaitable resolving once every operation
+        enqueued so far is committed. Backends that commit synchronously
+        (memory) return an immediately-complete awaitable."""
+        return _done_future()
+
     # -- messages (refcounted blobs; reference: insertMessage/selectMessage/
     #    deleteMessage + referMessage/unreferMessage) ----------------------
 
@@ -75,6 +88,11 @@ class StoreService:
 
     async def delete_message(self, msg_id: int) -> None:
         raise NotImplementedError
+
+    async def delete_messages(self, msg_ids: list[int]) -> None:
+        """Batch form of delete_message (hot on the ack path)."""
+        for msg_id in msg_ids:
+            await self.delete_message(msg_id)
 
     async def update_message_refer_count(self, msg_id: int, count: int) -> None:
         raise NotImplementedError

@@ -40,6 +40,10 @@ class MemoryStore(StoreService):
     async def delete_message(self, msg_id: int) -> None:
         self.messages.pop(msg_id, None)
 
+    async def delete_messages(self, msg_ids) -> None:
+        for msg_id in msg_ids:
+            self.messages.pop(msg_id, None)
+
     async def update_message_refer_count(self, msg_id: int, count: int) -> None:
         msg = self.messages.get(msg_id)
         if msg:

@@ -417,3 +417,23 @@ async def test_concurrent_consumers_round_robin(client):
     await asyncio.sleep(0.3)
     assert seen_by["a"] + seen_by["b"] == 20
     assert seen_by["a"] == 10 and seen_by["b"] == 10  # fair round-robin
+
+
+async def test_publish_cache_detects_props_mutation(client):
+    """The client's publish-template cache must re-encode when a reused
+    properties object is mutated between publishes (mutating a shared props
+    object per message is a common client pattern)."""
+    ch = await client.channel()
+    await ch.queue_declare("mutq")
+    props = BasicProperties(delivery_mode=1, correlation_id="a")
+    ch.basic_publish(b"m1", routing_key="mutq", properties=props)
+    props.delivery_mode = 2
+    props.correlation_id = "b"
+    ch.basic_publish(b"m2", routing_key="mutq", properties=props)
+    await client.drain()
+    m1 = await ch.basic_get("mutq", no_ack=True)
+    m2 = await ch.basic_get("mutq", no_ack=True)
+    assert m1.properties.delivery_mode == 1
+    assert m1.properties.correlation_id == "a"
+    assert m2.properties.delivery_mode == 2
+    assert m2.properties.correlation_id == "b"

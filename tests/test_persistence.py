@@ -107,7 +107,7 @@ async def test_sqlite_archive_on_delete(db_path):
         rows = db.execute("SELECT * FROM queue_msgs_deleted").fetchall()
         metas = db.execute("SELECT * FROM queue_metas_deleted").fetchall()
         return rows, metas
-    rows, metas = await store._exec(q)
+    rows, metas = await store._submit(q)
     assert len(rows) == 1 and rows[0][3] == 500
     assert len(metas) == 1
     await store.close()
@@ -313,13 +313,49 @@ async def test_message_refcount_deleted_when_all_queues_ack(db_path):
     m1 = await ch.basic_get("f_q1", no_ack=True)
     assert m1.body == b"shared"
     await asyncio.sleep(0.1)
-    msgs = await store._exec(lambda db: db.execute("SELECT id FROM msgs").fetchall())
+    msgs = await store._submit(lambda db: db.execute("SELECT id FROM msgs").fetchall())
     assert len(msgs) == 1  # still referenced by f_q2
 
     m2 = await ch.basic_get("f_q2", no_ack=True)
     await asyncio.sleep(0.1)
-    msgs = await store._exec(lambda db: db.execute("SELECT id FROM msgs").fetchall())
+    msgs = await store._submit(lambda db: db.execute("SELECT id FROM msgs").fetchall())
     assert msgs == []  # refcount hit zero -> blob deleted
 
     await c.close()
     await srv.stop()
+
+
+async def test_flush_barrier_surfaces_covered_write_failure(db_path):
+    """flush() is the confirm durability barrier: a fire-and-forget write
+    that fails inside the batch must fail the barrier, not just a log line
+    (otherwise a publisher confirm could paper over a lost persistent
+    message)."""
+    store = SqliteStore(db_path)
+    await store.open()
+    # fire-and-forget failing op (single statement against a missing table)
+    bad = store._submit(
+        lambda db: db.execute("INSERT INTO no_such_table VALUES (1)"),
+        guard=False)
+    bad.add_done_callback(lambda f: f.exception())  # consume, like store_bg
+    with pytest.raises(Exception):
+        await store.flush()
+    # the store keeps working afterwards; a clean barrier passes
+    await store.insert_message(StoredMessage(
+        id=1, properties_raw=b"", body=b"x", exchange="", routing_key="q",
+        refer_count=1))
+    await store.flush()
+    assert (await store.select_message(1)) is not None
+    await store.close()
+
+
+async def test_group_commit_batches_many_writes(db_path):
+    """Writes enqueued in one tick commit together and all resolve."""
+    store = SqliteStore(db_path)
+    await store.open()
+    futs = [store.insert_message(StoredMessage(
+        id=i, properties_raw=b"", body=b"b", exchange="", routing_key="q",
+        refer_count=1)) for i in range(500)]
+    await asyncio.gather(*futs)
+    for i in (0, 250, 499):
+        assert (await store.select_message(i)) is not None
+    await store.close()
