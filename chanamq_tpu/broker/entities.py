@@ -85,17 +85,27 @@ class Message:
 
 
 class QueuedMessage:
-    """A message's residency in one queue (offset, expiry, redelivery mark)."""
+    """A message's residency in one queue (offset, expiry, redelivery mark).
 
-    __slots__ = ("message", "offset", "expire_at_ms", "redelivered")
+    body_size is recorded separately from the message so QoS accounting and
+    store bookkeeping keep working while the body itself is passivated
+    (paged out to the store, reference: MessageEntity.scala:168-198)."""
+
+    __slots__ = ("message", "offset", "expire_at_ms", "redelivered",
+                 "body_size", "dead")
 
     def __init__(
-        self, message: Message, offset: int, expire_at_ms: Optional[int]
+        self, message: Message, offset: int, expire_at_ms: Optional[int],
+        body_size: Optional[int] = None,
     ) -> None:
         self.message = message
         self.offset = offset
         self.expire_at_ms = expire_at_ms
         self.redelivered = False
+        self.body_size = len(message.body) if body_size is None else body_size
+        # set when hydration finds the stored blob gone (TTL'd / deleted):
+        # dispatch and pop discard dead entries
+        self.dead = False
 
     def is_expired(self, now: Optional[int] = None) -> bool:
         return self.expire_at_ms is not None and (now or now_ms()) >= self.expire_at_ms
@@ -159,6 +169,8 @@ class Queue:
         # per-tick store-write coalescing (hot delivery/ack paths)
         self._wm_dirty = False  # a watermark persist is scheduled
         self._unack_del_buf: list[int] = []
+        # passivation: an async head-hydration pass is in flight
+        self._hydrating = False
 
     # -- introspection ----------------------------------------------------
 
@@ -192,9 +204,19 @@ class Queue:
             self.broker.store_bg(
                 self.broker.store.insert_queue_msg(
                     self.vhost, self.name, qm.offset, message.id,
-                    len(message.body), qm.expire_at_ms,
+                    qm.body_size, qm.expire_at_ms,
                 )
             )
+            # deep-backlog passivation (reference: MessageEntity pages
+            # inactive bodies out, MessageEntity.scala:168-198): beyond the
+            # per-queue resident watermark, drop the body from RAM — the
+            # store already holds it (insert enqueued above/at publish) and
+            # dispatch hydrates it back on demand.
+            if (len(self.messages) > self.broker.queue_max_resident
+                    and message.body is not None):
+                self.broker.account_memory(-qm.body_size)
+                message.body = None
+                message.header_raw = None
         self.schedule_dispatch()
         return qm
 
