@@ -48,6 +48,7 @@ class Broker:
         store: Optional[StoreService] = None,
         node_id: int = 0,
         message_sweep_interval_s: float = 1.0,
+        queue_max_resident: int = 16384,
     ) -> None:
         self.store = store or MemoryStore()
         self.idgen = IdGenerator(node_id)
@@ -56,10 +57,27 @@ class Broker:
         # set by chanamq_tpu.cluster.node.ClusterNode when clustering is on
         self.cluster = None
         self.message_sweep_interval_s = message_sweep_interval_s
+        # per-queue resident watermark: beyond this depth, durable+persistent
+        # bodies are paged out to the store (config chana.mq.queue.max-resident,
+        # the reference's passivation: MessageEntity.scala:168-198). 0 = off.
+        self.queue_max_resident = queue_max_resident or 0
+        # total message-body bytes resident in RAM (gauge; see account_memory)
+        self.resident_bytes = 0
         self._sweep_task: Optional[asyncio.Task] = None
         self._bg_tasks: set[asyncio.Task] = set()
         self._msg_delete_buf: list[int] = []
         self._started = False
+
+    def account_memory(self, delta: int) -> None:
+        """Track resident message-body bytes (passivation drops, hydration
+        reloads, publish adds, final unrefer releases)."""
+        self.resident_bytes += delta
+
+    def account_message(self, message: Message) -> None:
+        """Count a newly resident message body in the RAM gauge."""
+        if message.body is not None and not message.accounted:
+            self.account_memory(len(message.body))
+            message.accounted = True
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -146,17 +164,31 @@ class Broker:
             for msg_id, (offset, size, exp) in sq.unacks.items()
         ]
         entries.sort(key=lambda e: e[0])
-        max_offset = sq.last_consumed
-        for offset, msg_id, _size, expire_at in entries:
-            stored_msg = await self.store.select_message(msg_id)
-            if stored_msg is None:
-                continue
-            message = self._inflate(stored_msg)
-            message.refer_count = stored_msg.refer_count
-            message.persisted = True
-            from .entities import QueuedMessage
+        from .entities import QueuedMessage
 
-            qm = QueuedMessage(message, offset, expire_at)
+        # recovery honors the passivation watermark: metadata (props header,
+        # routing, refcount) loads for every entry in one batch, but bodies
+        # load only for the resident head — a deep durable backlog must not
+        # reload every blob into RAM (nor even read it: select_message_metas
+        # skips the body column)
+        ids = [msg_id for (_, msg_id, _, _) in entries]
+        metas = await self.store.select_message_metas(ids)
+        limit = self.queue_max_resident or len(entries)
+        resident_ids = [m for (_, m, _, _) in entries[:limit] if m in metas]
+        bodies = await self.store.select_messages(resident_ids)
+        max_offset = sq.last_consumed
+        for offset, msg_id, size, expire_at in entries:
+            meta = metas.get(msg_id)
+            if meta is None:
+                continue
+            message = self._inflate(meta)
+            message.refer_count = meta.refer_count
+            message.persisted = True
+            full = bodies.get(msg_id)
+            message.body = full.body if full is not None else None
+            if full is not None:
+                self.account_message(message)
+            qm = QueuedMessage(message, offset, expire_at, body_size=size)
             queue.messages.append(qm)
             max_offset = max(max_offset, offset)
         queue.next_offset = max_offset + 1
@@ -581,6 +613,7 @@ class Broker:
             properties.expiration_ms(), header_raw=header_raw,
         )
         message.refer_count = len(queues)
+        self.account_message(message)
         # persistence decision (reference: ExchangeEntity.scala:302):
         # message persistent AND at least one routed queue durable
         persist = message.is_persistent and any(q.durable for q in queues)
@@ -674,6 +707,7 @@ class Broker:
                 self.idgen.next_id(), properties, body, exchange_name,
                 routing_key, properties.expiration_ms(), header_raw=props_raw)
             message.refer_count = len(local)
+            self.account_message(message)
             persist = message.is_persistent and any(q.durable for q in local)
             if persist:
                 message.persisted = True
@@ -692,6 +726,9 @@ class Broker:
 
     def unrefer_n(self, message: Message, n: int) -> None:
         message.refer_count -= n
+        if message.refer_count <= 0 and message.accounted:
+            self.account_memory(-len(message.body or b""))
+            message.accounted = False
         if message.refer_count <= 0 and message.persisted:
             message.persisted = False
             # coalesce per loop tick: one executemany instead of a store op

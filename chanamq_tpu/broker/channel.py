@@ -127,7 +127,7 @@ class ServerChannel:
         return len(self.unacked)
 
     def total_unacked_size(self) -> int:
-        return sum(len(d.queued.message.body) for d in self.unacked.values())
+        return sum(d.queued.body_size for d in self.unacked.values())
 
     def set_qos(self, prefetch_size: int, prefetch_count: int, global_: bool) -> None:
         if global_:
@@ -229,7 +229,7 @@ class ServerChannel:
         if consumer is not None:
             consumer.unacked_count = max(0, consumer.unacked_count - 1)
             consumer.unacked_size = max(
-                0, consumer.unacked_size - len(delivery.queued.message.body)
+                0, consumer.unacked_size - delivery.queued.body_size
             )
 
     # -- ack paths ---------------------------------------------------------

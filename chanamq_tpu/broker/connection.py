@@ -805,7 +805,7 @@ class AMQPConnection:
             raise ChannelError(
                 ErrorCode.NOT_FOUND, f"no queue '{method.queue}'",
                 method.CLASS_ID, method.METHOD_ID)
-        qm = queue.basic_get()
+        qm = await queue.basic_get()
         if qm is None:
             self.send_method(channel.id, am.Basic.GetEmpty())
             return
@@ -832,7 +832,7 @@ class AMQPConnection:
                 # survive a restart
                 self.broker.store_bg(self.broker.store.insert_queue_unacks(
                     queue.vhost, queue.name,
-                    [(msg.id, qm.offset, len(msg.body), qm.expire_at_ms)]))
+                    [(msg.id, qm.offset, qm.body_size, qm.expire_at_ms)]))
 
     async def _on_get_remote(self, channel: ServerChannel, method: am.Basic.Get) -> None:
         """basic.get on a remotely-owned queue: fetch one message over RPC

@@ -25,6 +25,7 @@ No polling, no idle CPU burn, and delivery latency is one loop hop.
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from collections import deque
 from typing import TYPE_CHECKING, Any, Callable, Optional
@@ -37,6 +38,9 @@ if TYPE_CHECKING:  # pragma: no cover
     from .channel import Consumer, ServerChannel
 
 
+log = logging.getLogger("chanamq.broker")
+
+
 def now_ms() -> int:
     return int(time.time() * 1000)
 
@@ -47,6 +51,7 @@ class Message:
     __slots__ = (
         "id", "properties", "body", "exchange", "routing_key",
         "ttl_ms", "refer_count", "persisted", "published_ns", "header_raw",
+        "accounted",
     )
 
     def __init__(
@@ -71,6 +76,9 @@ class Message:
         # wire-format content-header payload; rendered lazily when absent
         # and reused for every delivery + the persisted blob
         self.header_raw = header_raw
+        # body bytes counted in Broker.resident_bytes (cleared on
+        # passivation / final unrefer so accounting never double-releases)
+        self.accounted = False
 
     def header_payload(self) -> bytes:
         hp = self.header_raw
@@ -171,6 +179,7 @@ class Queue:
         self._unack_del_buf: list[int] = []
         # passivation: an async head-hydration pass is in flight
         self._hydrating = False
+        self._hydrate_task: Optional[asyncio.Task] = None
 
     # -- introspection ----------------------------------------------------
 
@@ -210,27 +219,35 @@ class Queue:
             # deep-backlog passivation (reference: MessageEntity pages
             # inactive bodies out, MessageEntity.scala:168-198): beyond the
             # per-queue resident watermark, drop the body from RAM — the
-            # store already holds it (insert enqueued above/at publish) and
-            # dispatch hydrates it back on demand.
-            if (len(self.messages) > self.broker.queue_max_resident
+            # store already holds it (the blob insert was enqueued at publish
+            # and rides the same FIFO store queue, so hydration reads always
+            # see it) and dispatch hydrates it back on demand.
+            max_resident = self.broker.queue_max_resident
+            if (max_resident and len(self.messages) > max_resident
                     and message.body is not None):
-                self.broker.account_memory(-qm.body_size)
+                if message.accounted:
+                    self.broker.account_memory(-len(message.body))
+                    message.accounted = False
+                # only the body pages out; properties/header_raw stay so a
+                # hydrated delivery needs just the blob read
                 message.body = None
-                message.header_raw = None
         self.schedule_dispatch()
         return qm
 
     # -- dequeue / dispatch ------------------------------------------------
 
     def _expire_head(self) -> None:
+        """Drop expired and dead (blob gone from the store) head entries."""
         now = now_ms()
-        while self.messages and self.messages[0].is_expired(now):
+        while self.messages and (
+                self.messages[0].dead or self.messages[0].is_expired(now)):
             qm = self.messages.popleft()
             self._advance_watermark(qm)
             self.broker.unrefer(qm.message)
 
     def pop(self) -> Optional[QueuedMessage]:
-        """Pop the next live message (skipping+dropping expired heads)."""
+        """Pop the next live message (skipping+dropping expired/dead heads).
+        Callers must ensure the head is hydrated first (body is not None)."""
         self._expire_head()
         if not self.messages:
             return None
@@ -280,11 +297,25 @@ class Queue:
             return
         new_unacks: list[tuple[int, int, int, Optional[int]]] = []
         while self.messages and self.consumers:
+            self._expire_head()
+            if not self.messages:
+                break
+            if self.messages[0].message.body is None:
+                # head is passivated: reattach bodies from the store first;
+                # dispatch resumes when the hydration pass completes
+                # (reference: MessageEntity.Get lazy store load,
+                # MessageEntity.scala:82-102)
+                self._start_hydration()
+                break
             consumer = self._next_eligible_consumer()
             if consumer is None:
                 break
             qm = self.pop()
             if qm is None:
+                break
+            if qm.message.body is None:  # head changed under the checks above
+                self.messages.appendleft(qm)
+                self._start_hydration()
                 break
             delivery = consumer.deliver(self, qm)
             self._advance_watermark(qm)
@@ -294,12 +325,70 @@ class Queue:
                 self.outstanding[qm.offset] = delivery
                 if self.durable and qm.message.persisted:
                     new_unacks.append(
-                        (qm.message.id, qm.offset, len(qm.message.body), qm.expire_at_ms)
+                        (qm.message.id, qm.offset, qm.body_size, qm.expire_at_ms)
                     )
         if new_unacks:
             self.broker.store_bg(
                 self.broker.store.insert_queue_unacks(self.vhost, self.name, new_unacks)
             )
+
+    # -- passivation / hydration -------------------------------------------
+
+    HYDRATE_BATCH = 128
+
+    def _start_hydration(self) -> None:
+        if self._hydrating or self.deleted:
+            return
+        self._hydrating = True
+        self._hydrate_task = asyncio.get_event_loop().create_task(
+            self._hydrate_head())
+
+    async def _hydrate_head(self) -> None:
+        """Batch-reattach passivated bodies at the queue head from the store.
+        Entries whose blob is gone (TTL'd / deleted) are marked dead and
+        discarded by the next _expire_head pass."""
+        failed = False
+        try:
+            targets = []
+            for qm in self.messages:
+                if len(targets) >= self.HYDRATE_BATCH:
+                    break
+                if qm.message.body is None and not qm.dead:
+                    targets.append(qm)
+            if not targets:
+                return
+            stored = await self.broker.store.select_messages(
+                [qm.message.id for qm in targets])
+            if self.deleted:
+                return
+            for qm in targets:
+                msg = qm.message
+                if qm.dead or msg.refer_count <= 0:
+                    # purged/expired while the read was in flight: its final
+                    # unrefer already ran, so reattaching would leak the
+                    # resident_bytes accounting forever
+                    continue
+                sm = stored.get(msg.id)
+                if sm is None:
+                    qm.dead = True
+                elif msg.body is None:
+                    msg.body = sm.body
+                    if msg.header_raw is None:
+                        msg.header_raw = sm.properties_raw
+                    self.broker.account_memory(len(sm.body))
+                    msg.accounted = True
+        except Exception:
+            failed = True
+            log.exception("hydration of queue %s failed; retrying in 1s",
+                          self.name)
+        finally:
+            self._hydrating = False
+            self._hydrate_task = None
+        if failed:
+            # store trouble: back off instead of dispatch->hydrate spinning
+            asyncio.get_event_loop().call_later(1.0, self.schedule_dispatch)
+        else:
+            self.schedule_dispatch()
 
     def _next_eligible_consumer(self) -> Optional["Consumer"]:
         n = len(self.consumers)
@@ -311,16 +400,42 @@ class Queue:
         return None
 
     def _head_size(self) -> int:
+        # body_size, not len(body): the head may be passivated (body None)
         self._expire_head()
-        return len(self.messages[0].message.body) if self.messages else 0
+        return self.messages[0].body_size if self.messages else 0
 
     # -- get (polling read) ------------------------------------------------
 
-    def basic_get(self) -> Optional[QueuedMessage]:
-        qm = self.pop()
-        if qm is not None:
+    async def basic_get(self) -> Optional[QueuedMessage]:
+        """Pop one message, hydrating a passivated head from the store
+        first (the reference Promise-latches Get on the lazy store load,
+        MessageEntity.scala:82-102). The entry is CLAIMED (popped) before
+        the store read so a concurrent dispatch pass can't starve the get."""
+        while True:
+            self._expire_head()
+            if not self.messages:
+                return None
+            qm = self.messages.popleft()
+            msg = qm.message
+            if msg.body is None:
+                try:
+                    stored = await self.broker.store.select_messages([msg.id])
+                except Exception:
+                    self.messages.appendleft(qm)
+                    raise
+                sm = stored.get(msg.id)
+                if sm is None:  # blob gone: drop and try the next entry
+                    self._advance_watermark(qm)
+                    self.broker.unrefer(msg)
+                    continue
+                if msg.body is None:
+                    msg.body = sm.body
+                    if msg.header_raw is None:
+                        msg.header_raw = sm.properties_raw
+                    self.broker.account_memory(len(sm.body))
+                    msg.accounted = True
             self._advance_watermark(qm)
-        return qm
+            return qm
 
     # -- ack / requeue -----------------------------------------------------
 
@@ -380,7 +495,7 @@ class Queue:
                 self.broker.store_bg(
                     self.broker.store.insert_queue_msg(
                         self.vhost, self.name, qm.offset, qm.message.id,
-                        len(qm.message.body), qm.expire_at_ms,
+                        qm.body_size, qm.expire_at_ms,
                     )
                 )
                 self.broker.store_bg(

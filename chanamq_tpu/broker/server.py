@@ -130,6 +130,7 @@ class BrokerServer:
         broker = Broker(
             store=store,
             message_sweep_interval_s=sweep if sweep is not None else 0.0,
+            queue_max_resident=config.int("chana.mq.queue.max-resident"),
         )
         return cls(
             broker=broker,

@@ -493,6 +493,7 @@ class ClusterNode:
                 props.expiration_ms(), header_raw=bytes(payload["props_raw"]),
             )
             message.refer_count = len(queues)
+            self.broker.account_message(message)
             persist = message.is_persistent and any(q.durable for q in queues)
             if persist:
                 message.persisted = True
@@ -514,7 +515,7 @@ class ClusterNode:
 
     async def _h_queue_get(self, payload: dict) -> dict:
         queue = await self._local_queue(str(payload["vhost"]), str(payload["queue"]))
-        qm = queue.basic_get()
+        qm = await queue.basic_get()
         if qm is None:
             return {"empty": True, "message_count": queue.message_count}
         msg = qm.message
@@ -540,7 +541,7 @@ class ClusterNode:
             if queue.durable and msg.persisted:
                 self.broker.store_bg(self.broker.store.insert_queue_unacks(
                     queue.vhost, queue.name,
-                    [(msg.id, qm.offset, len(msg.body), qm.expire_at_ms)]))
+                    [(msg.id, qm.offset, qm.body_size, qm.expire_at_ms)]))
         return out
 
     async def _h_queue_consume(self, payload: dict) -> dict:

@@ -45,6 +45,12 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.internal.timeout": "20s",
     "chana.mq.message.inactive": "1h",
     "chana.mq.message.sweep-interval": "1s",
+    # per-queue resident-message watermark: beyond this many queued messages,
+    # durable+persistent bodies are paged out to the store and hydrated back
+    # on demand (the reference's passivation knob chana.mq.message.inactive,
+    # MessageEntity.scala:168-198, recast from age-based to depth-based).
+    # 0 disables passivation.
+    "chana.mq.queue.max-resident": 16384,
     "chana.mq.admin.enabled": True,
     "chana.mq.admin.interface": "127.0.0.1",
     "chana.mq.admin.port": 15672,

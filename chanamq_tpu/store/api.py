@@ -86,6 +86,23 @@ class StoreService:
     async def select_message(self, msg_id: int) -> Optional[StoredMessage]:
         raise NotImplementedError
 
+    async def select_messages(self, msg_ids: list[int]) -> dict[int, StoredMessage]:
+        """Batch form of select_message (hot on the hydration path —
+        reattaching passivated bodies at a queue head). Missing ids are
+        simply absent from the result."""
+        out: dict[int, StoredMessage] = {}
+        for msg_id in msg_ids:
+            msg = await self.select_message(msg_id)
+            if msg is not None:
+                out[msg_id] = msg
+        return out
+
+    async def select_message_metas(self, msg_ids: list[int]) -> dict[int, StoredMessage]:
+        """Batch metadata read: like select_messages but backends may omit
+        the body (body=None) — recovery uses it to rebuild deep backlogs
+        without reading every blob."""
+        return await self.select_messages(msg_ids)
+
     async def delete_message(self, msg_id: int) -> None:
         raise NotImplementedError
 

@@ -259,15 +259,44 @@ class SqliteStore(StoreService):
              msg.routing_key, msg.refer_count, msg.ttl_ms),
         ), guard=False)
 
-    async def select_message(self, msg_id: int) -> Optional[StoredMessage]:
-        row = await self._submit(lambda db: db.execute(
-            "SELECT * FROM msgs WHERE id=?", (msg_id,)).fetchone(), guard=False)
-        if row is None:
-            return None
+    @staticmethod
+    def _row_to_message(row) -> StoredMessage:
         return StoredMessage(
             id=row[0], properties_raw=row[1], body=row[2], exchange=row[3],
             routing_key=row[4], refer_count=row[5], ttl_ms=row[6],
         )
+
+    # stay under SQLITE_MAX_VARIABLE_NUMBER for giant recovery batches
+    _IN_CHUNK = 900
+
+    async def select_message(self, msg_id: int) -> Optional[StoredMessage]:
+        row = await self._submit(lambda db: db.execute(
+            "SELECT * FROM msgs WHERE id=?", (msg_id,)).fetchone(), guard=False)
+        return self._row_to_message(row) if row is not None else None
+
+    async def _select_in(self, columns: str, msg_ids: list[int]) -> list:
+        rows: list = []
+        for start in range(0, len(msg_ids), self._IN_CHUNK):
+            chunk = msg_ids[start:start + self._IN_CHUNK]
+            qmarks = ",".join("?" * len(chunk))
+            rows += await self._submit(lambda db, c=chunk, q=qmarks: db.execute(
+                f"SELECT {columns} FROM msgs WHERE id IN ({q})", c).fetchall(),
+                guard=False)
+        return rows
+
+    async def select_messages(self, msg_ids: list[int]) -> dict[int, StoredMessage]:
+        if not msg_ids:
+            return {}
+        rows = await self._select_in("*", msg_ids)
+        return {row[0]: self._row_to_message(row) for row in rows}
+
+    async def select_message_metas(self, msg_ids: list[int]) -> dict[int, StoredMessage]:
+        if not msg_ids:
+            return {}
+        rows = await self._select_in(
+            "id, header, NULL, exchange, routing_key, refer_count, ttl_ms",
+            msg_ids)
+        return {row[0]: self._row_to_message(row) for row in rows}
 
     def delete_message(self, msg_id: int):
         return self._submit(lambda db: db.execute(
