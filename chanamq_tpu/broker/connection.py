@@ -693,6 +693,13 @@ class AMQPConnection:
                 channel.ack(delivery)
         elif isinstance(method, am.Basic.Nack):
             deliveries = channel.resolve_tags(method.delivery_tag, method.multiple)
+            if not deliveries and not method.multiple:
+                # same contract as the Ack path: an unknown single tag is a
+                # channel error, not a silent no-op (0-9-1 precondition)
+                raise ChannelError(
+                    ErrorCode.PRECONDITION_FAILED,
+                    f"unknown delivery tag {method.delivery_tag}",
+                    method.CLASS_ID, method.METHOD_ID)
             for delivery in deliveries:
                 if method.requeue:
                     channel.requeue(delivery)
@@ -866,13 +873,13 @@ class AMQPConnection:
 
     def _on_recover(self, channel: ServerChannel, requeue: bool) -> None:
         """reference: FrameStage.scala:711-776."""
-        deliveries = [channel.unacked[t] for t in sorted(channel.unacked)]
         if requeue:
-            for delivery in deliveries:
-                channel.requeue(delivery)
+            # highest tag first -> requeue's appendleft fast path
+            for tag in sorted(channel.unacked, reverse=True):
+                channel.requeue(channel.unacked[tag])
         else:
-            for delivery in deliveries:
-                channel.redeliver(delivery)
+            for tag in sorted(channel.unacked):
+                channel.redeliver(channel.unacked[tag])
 
     # -- confirm / tx ------------------------------------------------------
 

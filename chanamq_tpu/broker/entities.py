@@ -474,14 +474,22 @@ class Queue:
                 )
             self.broker.unrefer(qm.message)
             return
-        # insert keeping offset order (requeues cluster near the head)
-        idx = 0
-        for idx, existing in enumerate(self.messages):
-            if existing.offset > qm.offset:
-                break
+        # insert keeping offset order. Requeues nearly always precede the
+        # whole backlog (they were at the head when delivered), so the O(1)
+        # end checks cover the hot cases; the linear scan is the rare
+        # interleaved-offset fallback.
+        if not self.messages or qm.offset < self.messages[0].offset:
+            self.messages.appendleft(qm)
+        elif qm.offset > self.messages[-1].offset:
+            self.messages.append(qm)
         else:
-            idx = len(self.messages)
-        self.messages.insert(idx, qm)
+            idx = 0
+            for idx, existing in enumerate(self.messages):
+                if existing.offset > qm.offset:
+                    break
+            else:
+                idx = len(self.messages)
+            self.messages.insert(idx, qm)
         # rewind the watermark so recovery replays it (reference rewinds
         # lastConsumed on requeue)
         if qm.offset <= self.last_consumed:

@@ -210,17 +210,36 @@ class TopicMatcher(Matcher):
         return [(k, q, None) for (k, q) in sorted(self._patterns)]
 
 
+_EMPTY_SET: frozenset = frozenset()
+
+
 class HeadersMatcher(Matcher):
     """Routes on message headers vs binding arguments (x-match=all|any).
 
     The reference declares the headers exchange type but never implements a
     matcher for it (AMQP.scala:33-47 lists HEADERS; no HeadersMatcher exists);
     this rebuild completes the capability.
+
+    Routing is index-driven, not a scan of every binding: each binding is
+    keyed in an inverted (header, value) index — every pair for x-match=any
+    (one hit IS a match), one representative pair for x-match=all (a
+    necessary condition; candidates are then fully verified). Only bindings
+    with unhashable values (field-table arrays/tables) fall back to the
+    always-verified bucket, and empty all-bindings match everything by
+    definition. Route cost is O(message headers + candidates), independent
+    of the total binding count.
     """
 
     def __init__(self) -> None:
         # (queue, frozen-args-key) -> (x_match_all, {header: value})
         self._bindings: dict[tuple[str, str], tuple[bool, dict]] = {}
+        # inverted indexes: (header, value) -> binding keys
+        self._any_index: dict[tuple, set] = {}
+        self._all_index: dict[tuple, set] = {}
+        self._unindexed: set = set()  # unhashable-valued bindings: always verify
+        self._empty_all: set = set()  # empty all-bindings: match everything
+        # bkey -> index keys used, for O(1) unbind
+        self._placement: dict[tuple[str, str], tuple[str, list]] = {}
 
     @staticmethod
     def _args_key(arguments: Optional[dict]) -> str:
@@ -233,31 +252,103 @@ class HeadersMatcher(Matcher):
         if bkey in self._bindings:
             return False
         self._bindings[bkey] = (x_match_all, args)
+        self._place(bkey, x_match_all, args)
         return True
 
+    def _place(self, bkey, x_match_all: bool, args: dict) -> None:
+        if not args:
+            if x_match_all:
+                self._empty_all.add(bkey)
+                self._placement[bkey] = ("empty_all", [])
+            else:
+                # empty any-binding can never match: keep it registered but
+                # reachable by no route
+                self._placement[bkey] = ("never", [])
+            return
+        hashable = []
+        unhashable = False
+        for h, v in args.items():
+            try:
+                hash(v)
+                hashable.append((h, v))
+            except TypeError:
+                unhashable = True
+        if x_match_all:
+            if hashable:
+                k = hashable[0]
+                self._all_index.setdefault(k, set()).add(bkey)
+                self._placement[bkey] = ("all", [k])
+            else:
+                self._unindexed.add(bkey)
+                self._placement[bkey] = ("unindexed", [])
+        else:
+            if unhashable:
+                # a message could match via the unhashable pair alone
+                self._unindexed.add(bkey)
+                self._placement[bkey] = ("unindexed", [])
+            else:
+                for k in hashable:
+                    self._any_index.setdefault(k, set()).add(bkey)
+                self._placement[bkey] = ("any", hashable)
+
+    def _unplace(self, bkey) -> None:
+        kind, keys = self._placement.pop(bkey, ("never", []))
+        if kind == "empty_all":
+            self._empty_all.discard(bkey)
+        elif kind == "unindexed":
+            self._unindexed.discard(bkey)
+        elif kind == "all":
+            for k in keys:
+                bucket = self._all_index.get(k)
+                if bucket is not None:
+                    bucket.discard(bkey)
+                    if not bucket:
+                        del self._all_index[k]
+        elif kind == "any":
+            for k in keys:
+                bucket = self._any_index.get(k)
+                if bucket is not None:
+                    bucket.discard(bkey)
+                    if not bucket:
+                        del self._any_index[k]
+
     def unbind(self, key: str, queue: str, arguments: Optional[dict] = None) -> bool:
-        return self._bindings.pop((queue, self._args_key(arguments)), None) is not None
+        bkey = (queue, self._args_key(arguments))
+        if self._bindings.pop(bkey, None) is None:
+            return False
+        self._unplace(bkey)
+        return True
 
     def unbind_queue(self, queue: str) -> int:
         keys = [bk for bk in self._bindings if bk[0] == queue]
         for bk in keys:
             del self._bindings[bk]
+            self._unplace(bk)
         return len(keys)
 
     def route(self, key: str, headers: Optional[dict] = None) -> set[str]:
         headers = headers or {}
-        matched: set[str] = set()
-        for (queue, _), (x_match_all, required) in self._bindings.items():
+        matched: set[str] = {queue for (queue, _) in self._empty_all}
+        candidates: set = set(self._unindexed)
+        if headers and (self._any_index or self._all_index):
+            for h, v in headers.items():
+                try:
+                    k = (h, v)
+                    candidates |= self._any_index.get(k, _EMPTY_SET)
+                    candidates |= self._all_index.get(k, _EMPTY_SET)
+                except TypeError:
+                    # unhashable header value: indexed binding values are all
+                    # hashable and can't equal it (list/dict vs scalar)
+                    continue
+        for bkey in candidates:
+            queue = bkey[0]
             if queue in matched:
                 continue
-            if not required:
-                hits = x_match_all  # empty binding: all-match succeeds trivially
-            else:
-                checks = (
-                    h in headers and headers[h] == v for h, v in required.items()
-                )
-                hits = all(checks) if x_match_all else any(checks)
-            if hits:
+            x_match_all, required = self._bindings[bkey]
+            checks = (
+                h in headers and headers[h] == v for h, v in required.items()
+            )
+            if all(checks) if x_match_all else any(checks):
                 matched.add(queue)
         return matched
 

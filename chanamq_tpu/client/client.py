@@ -270,7 +270,18 @@ class AMQPClient:
                     # own the method->header->body sequence and skip the
                     # generic assembler + Method object entirely.
                     if ftype == FrameType.METHOD:
-                        if payload[:4] == b"\x00\x3c\x00\x3c" and cid not in fast_partial:
+                        if cid in fast_partial:
+                            # §4.2.6: content frames are never interleaved
+                            # with methods on the same channel. Feeding the
+                            # assembler with fast state still active would
+                            # silently desynchronize delivery, so fail loud.
+                            del fast_partial[cid]
+                            await self._shutdown(ConnectionClosedError(
+                                505,
+                                "method frame interleaved with in-flight "
+                                f"content on channel {cid}"))
+                            return
+                        if payload[:4] == b"\x00\x3c\x00\x3c":
                             fast_partial[cid] = [
                                 _parse_deliver_fields(payload), None, 0, [], 0]
                             continue

@@ -218,8 +218,14 @@ class NativeTopicMatcher(Matcher):
         return len(keys)
 
     def route(self, key: str, headers: Optional[dict] = None) -> set[str]:
-        n = self._lib.chana_trie_route(
-            self._handle, key.encode(), self._out, len(self._out))
+        kb = key.encode()
+        n = self._lib.chana_trie_route(self._handle, kb, self._out, len(self._out))
+        while n > len(self._out):
+            # returned count is the TOTAL match count: grow and re-route
+            # instead of silently truncating at the buffer size
+            self._out = (ctypes.c_int32 * max(n, len(self._out) * 2))()
+            n = self._lib.chana_trie_route(
+                self._handle, kb, self._out, len(self._out))
         return {self._queue_names[self._out[i]] for i in range(n)}
 
     def bindings(self) -> list[tuple[str, str, Optional[dict]]]:

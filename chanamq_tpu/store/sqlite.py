@@ -98,9 +98,12 @@ class SqliteStore(StoreService):
         self._flush_scheduled = False
         self._batch_in_flight = False
         # count of ops that failed (op error or commit failure); flush()
-        # compares before/after so durability barriers surface covered
-        # failures even when the op itself was fire-and-forget
+        # raises whenever failures exist that no barrier has reported yet,
+        # so durability barriers surface covered failures even when the op
+        # itself was fire-and-forget AND even when the failing batch
+        # completed before the barrier was requested (idle fast path)
         self._fail_count = 0
+        self._fail_reported = 0
 
     # -- group-commit engine ----------------------------------------------
 
@@ -194,25 +197,36 @@ class SqliteStore(StoreService):
         # ops accumulated while the batch was committing -> next batch
         self._maybe_dispatch_batch()
 
+    def _unreported_failures(self) -> bool:
+        if self._fail_count > self._fail_reported:
+            self._fail_reported = self._fail_count
+            return True
+        return False
+
     def flush(self):
         """Durability barrier: awaitable resolving once every op enqueued so
-        far has been committed. Raises if ANY covered write failed — a
-        confirm released after this barrier must not paper over a failed
-        persistent insert that was enqueued fire-and-forget. Cheap when idle
-        (already-resolved future)."""
+        far has been committed. Raises if any write failed since the last
+        barrier that reported one — a confirm released after this barrier
+        must not paper over a failed persistent insert that was enqueued
+        fire-and-forget, including one whose batch already completed while
+        the event loop was busy elsewhere (the idle fast path checks too).
+        Cheap when idle (already-resolved future)."""
         loop = self._loop or asyncio.get_running_loop()
         if not self._pending and not self._batch_in_flight:
             fut: asyncio.Future = loop.create_future()
-            fut.set_result(None)
+            if self._unreported_failures():
+                fut.set_exception(RuntimeError(
+                    "store write failed before this durability barrier"))
+            else:
+                fut.set_result(None)
             return fut
-        fails_before = self._fail_count
         barrier = self._submit(lambda db: None, guard=False)
 
         async def wait() -> None:
             await barrier
             # FIFO resolution: every op enqueued before the barrier has been
             # resolved (and counted) by the time the barrier resolves
-            if self._fail_count != fails_before:
+            if self._unreported_failures():
                 raise RuntimeError(
                     "store write failed under this durability barrier")
 

@@ -184,10 +184,12 @@ int chana_trie_route(void* handle, const char* key, int32_t* out,
   split_words(key, &words);
   std::unordered_set<int32_t> matches;
   walk(&trie->root, words, 0, &matches);
+  // Returns the TOTAL match count while writing at most max_out ids, so the
+  // caller can detect truncation and retry with a larger buffer.
   int32_t n = 0;
   for (int32_t id : matches) {
-    if (n >= max_out) break;
-    out[n++] = id;
+    if (n < max_out) out[n] = id;
+    n++;
   }
   return n;
 }

@@ -123,6 +123,45 @@ def test_headers_any_match():
     assert m.route("", {}) == set()
 
 
+def test_headers_empty_bindings_and_unbind():
+    m = HeadersMatcher()
+    m.bind("", "qall", {"x-match": "all"})       # empty all: matches anything
+    m.bind("", "qany", {"x-match": "any"})       # empty any: never matches
+    m.bind("", "q1", {"x-match": "all", "k": "v"})
+    assert m.route("", {}) == {"qall"}
+    assert m.route("", {"k": "v"}) == {"qall", "q1"}
+    assert m.unbind("", "q1", {"x-match": "all", "k": "v"})
+    assert m.route("", {"k": "v"}) == {"qall"}
+    assert m.unbind_queue("qall") == 1
+    assert m.route("", {"k": "v"}) == set()
+
+
+def test_headers_unhashable_values_still_route():
+    """Field-table arrays are unhashable: those bindings take the verified
+    fallback bucket and must still match/unmatch correctly."""
+    m = HeadersMatcher()
+    m.bind("", "q1", {"x-match": "all", "tags": [1, 2]})
+    m.bind("", "q2", {"x-match": "any", "tags": [1, 2], "k": "v"})
+    assert m.route("", {"tags": [1, 2]}) == {"q1", "q2"}
+    assert m.route("", {"tags": [9]}) == set()
+    assert m.route("", {"k": "v"}) == {"q2"}
+    # unhashable MESSAGE header against hashable bindings: no crash, no match
+    m2 = HeadersMatcher()
+    m2.bind("", "q3", {"x-match": "any", "k": "v"})
+    assert m2.route("", {"k": [1]}) == set()
+
+
+def test_headers_index_scales_route_not_bindings():
+    """Route cost rides the index: with 2000 bindings on distinct values a
+    route touches only its own candidates (observable: correctness over a
+    large binding set; the per-route scan of every binding is gone)."""
+    m = HeadersMatcher()
+    for i in range(2000):
+        m.bind("", f"q{i}", {"x-match": "all", "shard": i})
+    assert m.route("", {"shard": 1234}) == {"q1234"}
+    assert m.route("", {"shard": -1}) == set()
+
+
 def test_matcher_factory():
     from chanamq_tpu import native_ext
 

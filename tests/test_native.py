@@ -142,3 +142,16 @@ def test_native_faster_than_python_parser():
     t_native = time.perf_counter() - t0
     # be generous (CI noise): just require it not be slower
     assert t_native < t_python * 1.1, (t_native, t_python)
+
+
+def test_native_trie_route_grows_past_buffer():
+    """The route result buffer starts at 4096; a fanout-wide topic binding
+    set larger than that must return EVERY queue, not a truncated set
+    (regression: silent truncation flagged in rounds 1-2)."""
+    m = native_ext.NativeTopicMatcher()
+    n = 5000
+    for i in range(n):
+        m.bind("wide.key", f"q{i}")
+    out = m.route("wide.key")
+    assert len(out) == n
+    assert out == {f"q{i}" for i in range(n)}

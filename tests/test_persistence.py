@@ -348,6 +348,30 @@ async def test_flush_barrier_surfaces_covered_write_failure(db_path):
     await store.close()
 
 
+async def test_flush_idle_fast_path_surfaces_earlier_failure(db_path):
+    """ADVICE r2: a fire-and-forget write that fails in a batch completing
+    BEFORE flush() is called must still fail the next barrier — the idle
+    fast path must not return an already-done success future over an
+    unreported failure."""
+    store = SqliteStore(db_path)
+    await store.open()
+    bad = store._submit(
+        lambda db: db.execute("INSERT INTO no_such_table VALUES (1)"),
+        guard=False)
+    bad.add_done_callback(lambda f: f.exception())  # consume, like store_bg
+    # let the failing batch fully complete so flush() takes the fast path
+    for _ in range(50):
+        await asyncio.sleep(0.01)
+        if not store._pending and not store._batch_in_flight:
+            break
+    assert not store._pending and not store._batch_in_flight
+    with pytest.raises(Exception):
+        await store.flush()
+    # reported once; the store keeps working and a clean barrier passes
+    await store.flush()
+    await store.close()
+
+
 async def test_group_commit_batches_many_writes(db_path):
     """Writes enqueued in one tick commit together and all resolve."""
     store = SqliteStore(db_path)
