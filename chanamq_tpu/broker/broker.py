@@ -574,6 +574,7 @@ class Broker:
         mandatory: bool = False,
         immediate: bool = False,
         header_raw: Optional[bytes] = None,
+        marks: Optional[list[tuple[int, int]]] = None,
     ) -> tuple[bool, bool]:
         """Route one message. Returns (routed, deliverable):
         routed=False    -> mandatory handling applies,
@@ -581,7 +582,12 @@ class Broker:
         Durability: persistent writes (message blob + queue-log residency)
         are ENQUEUED in order before return; callers that promise durability
         (publisher confirms, cluster push replies) must await
-        ``self.store.flush()`` — the group-commit barrier — before doing so."""
+        ``self.store.flush()`` — the group-commit barrier — before doing so.
+        marks, when given, collects the store-op enqueue windows of exactly
+        this publish's persistent writes (captured around the synchronous
+        enqueue block, so no foreign connection's ops can land inside even
+        when the clustered path awaits remote pushes) — pass them to
+        ``flush(intervals=...)`` for per-publisher failure attribution."""
         vhost = self.vhost(vhost_name)
         exchange = vhost.exchanges.get(exchange_name)
         if exchange is None:
@@ -604,23 +610,46 @@ class Broker:
             return await self._publish_clustered(
                 vhost, exchange_name, routing_key, properties, body,
                 queue_names, mandatory=mandatory, immediate=immediate,
-                header_raw=header_raw)
+                header_raw=header_raw, marks=marks)
         queues = [vhost.queues[qn] for qn in queue_names if qn in vhost.queues]
         if not queues:
             return (False, True)
+        if immediate and not any(
+            any(c.can_take(len(body)) for c in q.consumers) for q in queues
+        ):
+            return (True, False)
+        self.push_local(
+            queues, properties, body, exchange_name, routing_key,
+            header_raw, marks)
+        return (True, True)
+
+    def push_local(
+        self,
+        queues: list[Queue],
+        properties: BasicProperties,
+        body: bytes,
+        exchange_name: str,
+        routing_key: str,
+        header_raw: Optional[bytes],
+        marks: Optional[list[tuple[int, int]]],
+    ) -> Message:
+        """The one local persistent-enqueue block, shared by the single-node
+        publish, the clustered publish, and the cluster push handler: build
+        the Message, decide persistence (reference: ExchangeEntity.scala:302
+        — message persistent AND >=1 routed queue durable), enqueue the blob
+        (not awaited: the queue-log rows from queue.push() land in the SAME
+        group-commit batch, so one commit covers the message and all its
+        residencies), push to every queue with body_size computed once
+        (fanout passivation safety), and record the attribution window."""
+        mark0 = self.store.mark()
         message = Message(
             self.idgen.next_id(), properties, body, exchange_name, routing_key,
             properties.expiration_ms(), header_raw=header_raw,
         )
         message.refer_count = len(queues)
         self.account_message(message)
-        # persistence decision (reference: ExchangeEntity.scala:302):
-        # message persistent AND at least one routed queue durable
         persist = message.is_persistent and any(q.durable for q in queues)
         if persist:
-            # enqueue (not await): the queue-log rows from queue.push() below
-            # land in the SAME group-commit batch, so one commit covers the
-            # message blob and all its residencies.
             message.persisted = True
             self.store_bg(self.store.insert_message(StoredMessage(
                 id=message.id,
@@ -628,23 +657,21 @@ class Broker:
                 body=body, exchange=exchange_name, routing_key=routing_key,
                 refer_count=len(queues), ttl_ms=message.ttl_ms,
             )))
-        deliverable = True
-        if immediate:
-            deliverable = any(
-                any(c.can_take(len(body)) for c in q.consumers) for q in queues
-            )
-            if not deliverable:
-                self.unrefer_n(message, len(queues))
-                return (True, False)
+        body_size = len(body)
         for queue in queues:
-            queue.push(message)
-        return (True, True)
+            queue.push(message, body_size=body_size)
+        if marks is not None:
+            mark1 = self.store.mark()
+            if mark1 > mark0:
+                marks.append((mark0, mark1))
+        return message
 
     async def _publish_clustered(
         self, vhost: VHost, exchange_name: str, routing_key: str,
         properties: BasicProperties, body: bytes, queue_names: set[str],
         *, mandatory: bool, immediate: bool,
         header_raw: Optional[bytes] = None,
+        marks: Optional[list[tuple[int, int]]] = None,
     ) -> tuple[bool, bool]:
         """Cluster publish: routing already happened locally on the
         replicated exchange metadata; per-owner queue.push RPCs carry the
@@ -703,20 +730,9 @@ class Broker:
             # every target was remote and none accepted: unroutable in effect
             return (False, True)
         if local:
-            message = Message(
-                self.idgen.next_id(), properties, body, exchange_name,
-                routing_key, properties.expiration_ms(), header_raw=props_raw)
-            message.refer_count = len(local)
-            self.account_message(message)
-            persist = message.is_persistent and any(q.durable for q in local)
-            if persist:
-                message.persisted = True
-                self.store_bg(self.store.insert_message(StoredMessage(
-                    id=message.id, properties_raw=props_raw, body=body,
-                    exchange=exchange_name, routing_key=routing_key,
-                    refer_count=len(local), ttl_ms=message.ttl_ms)))
-            for queue in local:
-                queue.push(message)
+            self.push_local(
+                local, properties, body, exchange_name, routing_key,
+                props_raw, marks)
         return (True, True)
 
     # -- message refcounting (reference: MessageEntity.scala:134-166) ------

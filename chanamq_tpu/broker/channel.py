@@ -145,6 +145,11 @@ class ServerChannel:
         self._delivery_tag += 1
         return self._delivery_tag
 
+    def tag_was_issued(self, tag: int) -> bool:
+        """Whether this delivery tag was ever issued on the channel (ack/nack
+        validation: an above-range tag is unknown even with multiple=true)."""
+        return 0 < tag <= self._delivery_tag
+
     def deliver(
         self, consumer: Consumer, queue: Queue, qm: QueuedMessage
     ) -> Optional[Delivery]:

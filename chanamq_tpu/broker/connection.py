@@ -136,6 +136,10 @@ class AMQPConnection:
         # confirm coalescing: channel id -> highest publish seq completed in
         # the current read batch; flushed as one Basic.Ack(multiple) per batch
         self._pending_confirms: dict[int, int] = {}
+        # store-op enqueue windows (store.mark() pairs) covering THIS
+        # connection's confirmed persistent publishes; passed to
+        # flush(intervals=...) so the barrier fails only for our own writes
+        self._confirm_marks: list[tuple[int, int]] = []
 
     # ------------------------------------------------------------------
     # output path
@@ -263,10 +267,11 @@ class AMQPConnection:
         """Durability barrier before releasing publisher confirms: a confirm
         may only reach the client once the store has committed every write
         the confirmed publishes enqueued (message blob + queue-log rows —
-        all in one group-commit batch). Free for transient traffic: flush()
-        returns an already-done future when nothing is pending."""
+        all in one group-commit batch). Free for transient traffic: with no
+        enqueue windows recorded, flush([]) resolves immediately."""
         if self._pending_confirms:
-            await self.broker.store.flush()
+            intervals, self._confirm_marks = self._confirm_marks, []
+            await self.broker.store.flush(intervals)
 
     def _flush_confirms(self) -> None:
         if not self._pending_confirms:
@@ -684,22 +689,12 @@ class AMQPConnection:
             await self._on_get(channel, method)
         elif isinstance(method, am.Basic.Ack):
             deliveries = channel.resolve_tags(method.delivery_tag, method.multiple)
-            if not deliveries and not method.multiple:
-                raise ChannelError(
-                    ErrorCode.PRECONDITION_FAILED,
-                    f"unknown delivery tag {method.delivery_tag}",
-                    method.CLASS_ID, method.METHOD_ID)
+            self._check_settled_tags(channel, method, deliveries)
             for delivery in deliveries:
                 channel.ack(delivery)
         elif isinstance(method, am.Basic.Nack):
             deliveries = channel.resolve_tags(method.delivery_tag, method.multiple)
-            if not deliveries and not method.multiple:
-                # same contract as the Ack path: an unknown single tag is a
-                # channel error, not a silent no-op (0-9-1 precondition)
-                raise ChannelError(
-                    ErrorCode.PRECONDITION_FAILED,
-                    f"unknown delivery tag {method.delivery_tag}",
-                    method.CLASS_ID, method.METHOD_ID)
+            self._check_settled_tags(channel, method, deliveries)
             for delivery in deliveries:
                 if method.requeue:
                     channel.requeue(delivery)
@@ -707,6 +702,7 @@ class AMQPConnection:
                     channel.drop(delivery)
         elif isinstance(method, am.Basic.Reject):
             deliveries = channel.resolve_tags(method.delivery_tag, False)
+            self._check_settled_tags(channel, method, deliveries, multiple=False)
             for delivery in deliveries:
                 if method.requeue:
                     channel.requeue(delivery)
@@ -721,6 +717,29 @@ class AMQPConnection:
                 ErrorCode.COMMAND_INVALID, f"unexpected {method.NAME}",
                 method.CLASS_ID, method.METHOD_ID)
 
+    @staticmethod
+    def _check_settled_tags(
+        channel: ServerChannel, method, deliveries: list,
+        multiple: Optional[bool] = None,
+    ) -> None:
+        """Ack/Nack/Reject tag validation (RabbitMQ contract): an unknown
+        tag is a channel PRECONDITION_FAILED, not a silent no-op. With
+        multiple=true a tag never issued on this channel (above the
+        delivery-tag counter) is equally unknown; a tag inside the issued
+        range whose deliveries are already settled is a legal no-op.
+        multiple overrides method.multiple for methods without the field
+        (Reject)."""
+        if deliveries:
+            return
+        tag = method.delivery_tag
+        if multiple is None:
+            multiple = method.multiple
+        if not multiple or (tag != 0 and not channel.tag_was_issued(tag)):
+            raise ChannelError(
+                ErrorCode.PRECONDITION_FAILED,
+                f"unknown delivery tag {tag}",
+                method.CLASS_ID, method.METHOD_ID)
+
     async def _on_publish(self, channel: ServerChannel, command: AMQCommand) -> None:
         method = command.method
         props = command.properties or BasicProperties()
@@ -733,6 +752,7 @@ class AMQPConnection:
             props, command.body,
             mandatory=method.mandatory, immediate=method.immediate,
             header_raw=command.header_raw,
+            marks=self._confirm_marks if seq is not None else None,
         )
         if not routed and method.mandatory:
             self.broker.metrics.returned_msgs += 1

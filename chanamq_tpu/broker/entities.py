@@ -205,8 +205,12 @@ class Queue:
             return None
         return now_ms() + min(ttls)
 
-    def push(self, message: Message) -> QueuedMessage:
-        qm = QueuedMessage(message, self.next_offset, self.clamp_expiry(message))
+    def push(self, message: Message, body_size: Optional[int] = None) -> QueuedMessage:
+        # body_size is computed ONCE by the publisher and passed to every
+        # routed queue: a fanout sibling may already have passivated the
+        # shared body (message.body is None), so it can't be re-measured here
+        qm = QueuedMessage(message, self.next_offset, self.clamp_expiry(message),
+                           body_size=body_size)
         self.next_offset += 1
         self.messages.append(qm)
         if self.durable and message.persisted:

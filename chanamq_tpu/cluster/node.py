@@ -467,8 +467,6 @@ class ClusterNode:
     async def _h_queue_push(self, payload: dict) -> dict:
         """Accept routed messages for locally-owned queues (the reference's
         QueueEntity.Push ask, QueueEntity.scala:271-316)."""
-        from ..broker.entities import Message
-
         vhost = str(payload["vhost"])
         queue_names = [str(q) for q in payload.get("queues") or []]
         _, _, props = BasicProperties.decode_header(bytes(payload["props_raw"]))
@@ -487,30 +485,16 @@ class ClusterNode:
         if check_consumers and not had_consumer:
             return {"pushed": False, "had_consumer": False}
         if queues:
-            message = Message(
-                self.broker.idgen.next_id(), props, body,
+            marks: list[tuple[int, int]] = []
+            message = self.broker.push_local(
+                queues, props, body,
                 str(payload["exchange"]), str(payload["routing_key"]),
-                props.expiration_ms(), header_raw=bytes(payload["props_raw"]),
-            )
-            message.refer_count = len(queues)
-            self.broker.account_message(message)
-            persist = message.is_persistent and any(q.durable for q in queues)
-            if persist:
-                message.persisted = True
-                from ..store.api import StoredMessage
-
-                self.broker.store_bg(self.broker.store.insert_message(StoredMessage(
-                    id=message.id, properties_raw=bytes(payload["props_raw"]),
-                    body=body, exchange=message.exchange,
-                    routing_key=message.routing_key,
-                    refer_count=len(queues), ttl_ms=message.ttl_ms,
-                )))
-            for queue in queues:
-                queue.push(message)
-            if persist:
+                bytes(payload["props_raw"]), marks)
+            if message.persisted:
                 # the reply releases the origin's confirm: barrier on the
                 # group commit covering the blob + queue-log rows above
-                await self.broker.store.flush()
+                # (attributed to just this push's enqueue window)
+                await self.broker.store.flush(marks)
         return {"pushed": bool(queues), "had_consumer": had_consumer}
 
     async def _h_queue_get(self, payload: dict) -> dict:

@@ -71,11 +71,23 @@ class StoreService:
 
     async def close(self) -> None: ...
 
-    def flush(self):
+    def flush(self, intervals: Optional[list[tuple[int, int]]] = None):
         """Durability barrier: awaitable resolving once every operation
         enqueued so far is committed. Backends that commit synchronously
-        (memory) return an immediately-complete awaitable."""
+        (memory) return an immediately-complete awaitable.
+
+        intervals: optional list of (mark_before, mark_after) enqueue
+        windows captured via mark(); backends with failure attribution
+        (SqliteStore) raise only for failures inside the caller's own
+        windows, so one publisher's failed write never errors — or silently
+        passes under — another publisher's barrier."""
         return _done_future()
+
+    def mark(self) -> int:
+        """Op-sequence watermark for flush(intervals=...). Backends without
+        enqueue sequencing return 0 (callers then pass empty/degenerate
+        intervals and flush() behaves as a plain barrier)."""
+        return 0
 
     # -- messages (refcounted blobs; reference: insertMessage/selectMessage/
     #    deleteMessage + referMessage/unreferMessage) ----------------------

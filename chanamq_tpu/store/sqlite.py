@@ -94,16 +94,25 @@ class SqliteStore(StoreService):
         # single writer thread => strict FIFO op ordering
         self._executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="store")
         # group-commit state (event-loop side)
-        self._pending: list[tuple[Callable[[sqlite3.Connection], Any], asyncio.Future]] = []
+        self._pending: list[
+            tuple[Callable[[sqlite3.Connection], Any], asyncio.Future, bool, int]
+        ] = []
         self._flush_scheduled = False
         self._batch_in_flight = False
-        # count of ops that failed (op error or commit failure); flush()
-        # raises whenever failures exist that no barrier has reported yet,
-        # so durability barriers surface covered failures even when the op
-        # itself was fire-and-forget AND even when the failing batch
-        # completed before the barrier was requested (idle fast path)
-        self._fail_count = 0
-        self._fail_reported = 0
+        # failure attribution: every op gets a sequence number at enqueue;
+        # failed ops (op error or commit failure) record their seq so a
+        # durability barrier can raise for exactly the ops it covers.
+        # Callers that promise durability for a specific window (publisher
+        # confirms, cluster push replies) capture mark() around their
+        # enqueues and pass those intervals to flush() — so one publisher's
+        # failed insert never errors (or silently passes under) another
+        # publisher's barrier (the reference's scar this engine was built to
+        # beat, CassandraOpService.scala:753-755).
+        self._op_seq = 0
+        self._failed_seqs: list[int] = []
+        self._failed_floor = 0  # seqs <= floor were dropped from the list:
+        # any interval reaching below it reports failure conservatively
+        self._reported_mark = 0  # consume-once watermark for global flush()
 
     # -- group-commit engine ----------------------------------------------
 
@@ -119,7 +128,8 @@ class SqliteStore(StoreService):
         so a mid-op failure can't leave a partial effect in the batch."""
         loop = self._loop or asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._pending.append((fn, fut, guard))
+        self._op_seq += 1
+        self._pending.append((fn, fut, guard, self._op_seq))
         if not self._flush_scheduled:
             # coalesce everything submitted this loop tick into one batch
             self._flush_scheduled = True
@@ -141,34 +151,34 @@ class SqliteStore(StoreService):
         assert loop is not None
 
         def run_batch() -> None:
-            results: list[tuple[asyncio.Future, Any, Optional[BaseException]]] = []
+            results: list[tuple[asyncio.Future, Any, Optional[BaseException], int]] = []
             try:
                 # IMMEDIATE: take the write lock up front so multi-process
                 # users (nodes sharing a db file) serialize cleanly
                 db.execute("BEGIN IMMEDIATE")
             except Exception as exc:  # pragma: no cover - disk/lock failure
                 loop.call_soon_threadsafe(
-                    self._batch_done, [(f, None, exc) for _, f, _ in batch])
+                    self._batch_done, [(f, None, exc, s) for _, f, _, s in batch])
                 return
-            for fn, fut, guard in batch:
+            for fn, fut, guard, seq in batch:
                 if guard:
                     try:
                         db.execute("SAVEPOINT op")
                         res = fn(db)
                         db.execute("RELEASE SAVEPOINT op")
-                        results.append((fut, res, None))
+                        results.append((fut, res, None, seq))
                     except Exception as exc:
                         try:
                             db.execute("ROLLBACK TO SAVEPOINT op")
                             db.execute("RELEASE SAVEPOINT op")
                         except Exception:  # pragma: no cover
                             pass
-                        results.append((fut, None, exc))
+                        results.append((fut, None, exc, seq))
                 else:
                     try:
-                        results.append((fut, fn(db), None))
+                        results.append((fut, fn(db), None, seq))
                     except Exception as exc:
-                        results.append((fut, None, exc))
+                        results.append((fut, None, exc, seq))
             try:
                 db.execute("COMMIT")
             except Exception as exc:  # pragma: no cover - disk failure
@@ -176,18 +186,25 @@ class SqliteStore(StoreService):
                     db.execute("ROLLBACK")
                 except Exception:
                     pass
-                results = [(f, None, exc) for f, _, _ in results]
+                results = [(f, None, exc, s) for f, _, _, s in results]
             loop.call_soon_threadsafe(self._batch_done, results)
 
         self._executor.submit(run_batch)
 
+    _FAILED_CAP = 4096
+
     def _batch_done(
-        self, results: list[tuple[asyncio.Future, Any, Optional[BaseException]]]
+        self, results: list[tuple[asyncio.Future, Any, Optional[BaseException], int]]
     ) -> None:
         self._batch_in_flight = False
-        for fut, res, exc in results:
+        for fut, res, exc, seq in results:
             if exc is not None:
-                self._fail_count += 1
+                self._failed_seqs.append(seq)
+                if len(self._failed_seqs) > self._FAILED_CAP:
+                    # bound the list; barriers reaching below the floor
+                    # report failure conservatively
+                    self._failed_floor = max(
+                        self._failed_floor, self._failed_seqs.pop(0))
             if fut.cancelled():
                 continue
             if exc is not None:
@@ -197,24 +214,61 @@ class SqliteStore(StoreService):
         # ops accumulated while the batch was committing -> next batch
         self._maybe_dispatch_batch()
 
-    def _unreported_failures(self) -> bool:
-        if self._fail_count > self._fail_reported:
-            self._fail_reported = self._fail_count
-            return True
+    def mark(self) -> int:
+        """Sequence number of the last op enqueued. Capture around a group
+        of enqueues and pass the (before, after] interval to flush() for
+        per-caller failure attribution."""
+        return self._op_seq
+
+    def _failures_in(self, intervals: list[tuple[int, int]]) -> bool:
+        for s0, s1 in intervals:
+            if s0 < self._failed_floor:
+                return True
+            for s in reversed(self._failed_seqs):
+                if s0 < s <= s1:
+                    return True
         return False
 
-    def flush(self):
+    def _unreported_failures(self, barrier_mark: int) -> bool:
+        had = self._failures_in([(self._reported_mark, barrier_mark)])
+        if barrier_mark > self._reported_mark:
+            self._reported_mark = barrier_mark
+        return had
+
+    def flush(self, intervals: Optional[list[tuple[int, int]]] = None):
         """Durability barrier: awaitable resolving once every op enqueued so
-        far has been committed. Raises if any write failed since the last
-        barrier that reported one — a confirm released after this barrier
-        must not paper over a failed persistent insert that was enqueued
-        fire-and-forget, including one whose batch already completed while
-        the event loop was busy elsewhere (the idle fast path checks too).
+        far has been committed.
+
+        intervals=None (global barrier — shutdown, tests): raises if any
+        write failed that no previous global barrier reported — a confirm
+        released after this barrier must not paper over a failed persistent
+        insert that was enqueued fire-and-forget, including one whose batch
+        already completed while the event loop was busy elsewhere (the idle
+        fast path checks too).
+
+        intervals=[(mark_before, mark_after), ...] (attributed barrier —
+        publisher confirms, cluster push replies): raises iff a failed op's
+        seq falls inside one of the caller's own enqueue windows, so
+        connection A's barrier can neither consume nor trip over
+        connection B's failure. An empty list means the caller enqueued
+        nothing it needs committed: resolves immediately, no barrier.
+
         Cheap when idle (already-resolved future)."""
         loop = self._loop or asyncio.get_running_loop()
-        if not self._pending and not self._batch_in_flight:
+        if intervals is not None and not intervals:
             fut: asyncio.Future = loop.create_future()
-            if self._unreported_failures():
+            fut.set_result(None)
+            return fut
+        barrier_mark = self._op_seq
+
+        def covered_failure() -> bool:
+            if intervals is not None:
+                return self._failures_in(intervals)
+            return self._unreported_failures(barrier_mark)
+
+        if not self._pending and not self._batch_in_flight:
+            fut = loop.create_future()
+            if covered_failure():
                 fut.set_exception(RuntimeError(
                     "store write failed before this durability barrier"))
             else:
@@ -225,8 +279,8 @@ class SqliteStore(StoreService):
         async def wait() -> None:
             await barrier
             # FIFO resolution: every op enqueued before the barrier has been
-            # resolved (and counted) by the time the barrier resolves
-            if self._unreported_failures():
+            # resolved (and its failure recorded) by the time it resolves
+            if covered_failure():
                 raise RuntimeError(
                     "store write failed under this durability barrier")
 

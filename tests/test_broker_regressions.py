@@ -120,3 +120,54 @@ async def test_wait_unconfirmed_wakes_on_close(server):
     with pytest.raises((ChannelClosedError, asyncio.TimeoutError)):
         await ch.wait_unconfirmed_below(1, timeout=10)
     assert asyncio.get_event_loop().time() - t0 < 5  # woke early, not at timeout
+
+
+async def test_nack_multiple_unknown_tag_is_channel_error(client):
+    """ADVICE r3: an unknown nonzero tag with multiple=true that resolves no
+    deliveries must raise PRECONDITION_FAILED like the single-tag path
+    (RabbitMQ errors on unknown nonzero tags regardless of multiple)."""
+    ch = await client.channel()
+    await ch.queue_declare("nack_q")
+    # no deliveries ever issued on this channel: tag 5 is above the range
+    client._send_method(ch.id, am.Basic.Nack(
+        delivery_tag=5, multiple=True, requeue=True))
+    await asyncio.sleep(0.2)
+    assert ch.closed
+    assert ch.close_reason.reply_code == 406
+
+
+async def test_ack_multiple_settled_range_is_noop(client):
+    """A multiple ack whose covered tags are already settled is a legal
+    no-op (tag within the issued range) — only above-range tags error."""
+    ch = await client.channel()
+    await ch.queue_declare("ack_q")
+    ch.basic_publish(b"m1", routing_key="ack_q")
+    m = None
+    for _ in range(50):
+        m = await ch.basic_get("ack_q")
+        if m is not None:
+            break
+        await asyncio.sleep(0.02)
+    assert m is not None
+    ch.basic_ack(m.delivery_tag)
+    # re-ack the same (settled) tag with multiple=true: inside issued range
+    client._send_method(ch.id, am.Basic.Ack(
+        delivery_tag=m.delivery_tag, multiple=True))
+    await asyncio.sleep(0.2)
+    assert not ch.closed
+    # but an above-range multiple ack errors
+    client._send_method(ch.id, am.Basic.Ack(delivery_tag=99, multiple=True))
+    await asyncio.sleep(0.2)
+    assert ch.closed
+    assert ch.close_reason.reply_code == 406
+
+
+async def test_reject_unknown_tag_is_channel_error(client):
+    """Basic.Reject with an unknown tag follows the same RabbitMQ contract
+    as Ack/Nack: PRECONDITION_FAILED, not a silent no-op."""
+    ch = await client.channel()
+    await ch.queue_declare("rej_q")
+    client._send_method(ch.id, am.Basic.Reject(delivery_tag=3, requeue=True))
+    await asyncio.sleep(0.2)
+    assert ch.closed
+    assert ch.close_reason.reply_code == 406

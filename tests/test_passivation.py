@@ -177,6 +177,48 @@ async def test_recovery_respects_resident_watermark(db_path):
     await srv2.stop()
 
 
+async def test_fanout_passivation_shares_body_safely(db_path):
+    """Advisor round-3 high: a persistent message fanned out to multiple
+    durable queues must survive one queue passivating the shared body —
+    body_size is computed once at publish, and the sibling queue hydrates
+    from the store like any passivated entry."""
+    srv = await start_server(db_path, max_resident=4)
+    c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    ch = await c.channel()
+    await ch.confirm_select()
+    await ch.exchange_declare("fan_x", "fanout", durable=True)
+    await ch.queue_declare("fan_a", durable=True)
+    await ch.queue_declare("fan_b", durable=True)
+    await ch.queue_bind("fan_a", "fan_x", "")
+    await ch.queue_bind("fan_b", "fan_x", "")
+
+    n = 12  # well past max_resident=4: the advisor repro crashed on the 5th
+    for i in range(n):
+        ch.basic_publish(b"fan-%02d" % i, exchange="fan_x", routing_key="",
+                         properties=PERSISTENT)
+    await ch.wait_unconfirmed_below(1)
+
+    qa = srv.broker.vhosts["/"].queues["fan_a"]
+    qb = srv.broker.vhosts["/"].queues["fan_b"]
+    assert len(qa.messages) == n and len(qb.messages) == n
+    # every entry carries the true body size even where the shared body was
+    # paged out by the sibling queue
+    assert all(qm.body_size == 6 for qm in qa.messages)
+    assert all(qm.body_size == 6 for qm in qb.messages)
+
+    # both queues drain fully, in order, with hydrated bodies
+    for qname in ("fan_a", "fan_b"):
+        got = []
+        while True:
+            m = await ch.basic_get(qname, no_ack=True)
+            if m is None:
+                break
+            got.append(m.body)
+        assert got == [b"fan-%02d" % i for i in range(n)]
+    await c.close()
+    await srv.stop()
+
+
 async def test_transient_queues_never_passivate(db_path):
     """Passivation applies only where the store holds the body: a transient
     (non-persistent) publish into the same durable queue keeps its body."""

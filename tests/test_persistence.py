@@ -372,6 +372,58 @@ async def test_flush_idle_fast_path_surfaces_earlier_failure(db_path):
     await store.close()
 
 
+async def test_flush_attribution_two_confirm_publishers(db_path):
+    """VERDICT r3 #6: with two confirm-mode connections, a store failure on
+    B's insert must fail ONLY B's durability barrier — A gets a clean
+    confirm, and A's barrier must not consume the failure report out from
+    under B's (the round-3 consume-once scar)."""
+    srv = await start_server(db_path)
+    store = srv.broker.store
+    orig_insert = store.insert_message
+
+    def failing_insert(msg):
+        if msg.routing_key == "qb":
+            return store._submit(
+                lambda db: db.execute("INSERT INTO no_such_table VALUES (1)"),
+                guard=False)
+        return orig_insert(msg)
+
+    store.insert_message = failing_insert
+    a = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    b = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    cha = await a.channel()
+    chb = await b.channel()
+    await cha.confirm_select()
+    await chb.confirm_select()
+    await cha.queue_declare("qa", durable=True)
+    await chb.queue_declare("qb", durable=True)
+
+    # both publishes race into the same group-commit window
+    chb.basic_publish(b"lost", routing_key="qb", properties=PERSISTENT)
+    cha.basic_publish(b"kept", routing_key="qa", properties=PERSISTENT)
+
+    # A's barrier covers only A's writes: clean confirm
+    await cha.wait_unconfirmed_below(1, timeout=10)
+    # B must never see a confirm for the lost message: its barrier raises
+    # and the server drops the connection
+    with pytest.raises(Exception):
+        await chb.wait_unconfirmed_below(1, timeout=10)
+    assert len(chb.unconfirmed) == 1  # the publish was never confirmed
+
+    # A's message really is durable
+    store.insert_message = orig_insert
+    await a.close()
+    await b.close()
+    await srv.stop()
+    srv2 = await start_server(db_path)
+    c2 = await AMQPClient.connect("127.0.0.1", srv2.bound_port)
+    ch2 = await c2.channel()
+    got = await ch2.basic_get("qa", no_ack=True)
+    assert got is not None and got.body == b"kept"
+    await c2.close()
+    await srv2.stop()
+
+
 async def test_group_commit_batches_many_writes(db_path):
     """Writes enqueued in one tick commit together and all resolve."""
     store = SqliteStore(db_path)
