@@ -49,6 +49,8 @@ class Broker:
         node_id: int = 0,
         message_sweep_interval_s: float = 1.0,
         queue_max_resident: int = 16384,
+        memory_high_watermark: int = 0,
+        memory_low_watermark: Optional[int] = None,
     ) -> None:
         self.store = store or MemoryStore()
         self.idgen = IdGenerator(node_id)
@@ -63,6 +65,28 @@ class Broker:
         self.queue_max_resident = queue_max_resident or 0
         # total message-body bytes resident in RAM (gauge; see account_memory)
         self.resident_bytes = 0
+        # inbound publisher backpressure (reference leaned on akka-streams
+        # demand + TCP, SURVEY.md §7.3): above the high watermark the memory
+        # gate closes and publishing connections stop reading; it reopens
+        # below the low watermark (default 80% of high). 0 disables.
+        self.memory_high_watermark = memory_high_watermark or 0
+        self.memory_low_watermark = (
+            memory_low_watermark if memory_low_watermark is not None
+            else int(self.memory_high_watermark * 0.8))
+        if (self.memory_high_watermark
+                and self.memory_low_watermark >= self.memory_high_watermark):
+            # low >= high would make the gate flap on every accounting tick
+            log.warning(
+                "memory low watermark %d >= high %d; clamping to 80%% of high",
+                self.memory_low_watermark, self.memory_high_watermark)
+            self.memory_low_watermark = int(self.memory_high_watermark * 0.8)
+        self.blocked = False
+        self._memory_gate = asyncio.Event()
+        self._memory_gate.set()
+        # callbacks fired on block/unblock transitions (connections send
+        # Connection.Blocked/Unblocked to capable clients — an extension
+        # the reference never implemented, README.md:10-22)
+        self.blocked_listeners: set[Any] = set()
         self._sweep_task: Optional[asyncio.Task] = None
         self._bg_tasks: set[asyncio.Task] = set()
         self._msg_delete_buf: list[int] = []
@@ -70,14 +94,56 @@ class Broker:
 
     def account_memory(self, delta: int) -> None:
         """Track resident message-body bytes (passivation drops, hydration
-        reloads, publish adds, final unrefer releases)."""
+        reloads, publish adds, final unrefer releases) and drive the
+        publisher-backpressure gate off the gauge."""
         self.resident_bytes += delta
+        if not self.memory_high_watermark:
+            return
+        if not self.blocked and self.resident_bytes > self.memory_high_watermark:
+            self.blocked = True
+            self._memory_gate.clear()
+            self._notify_blocked(True)
+        elif self.blocked and self.resident_bytes <= self.memory_low_watermark:
+            self.blocked = False
+            self._memory_gate.set()
+            self._notify_blocked(False)
+
+    def _notify_blocked(self, blocked: bool) -> None:
+        log.warning("memory %s: resident=%d high=%d low=%d",
+                    "BLOCKED" if blocked else "unblocked",
+                    self.resident_bytes, self.memory_high_watermark,
+                    self.memory_low_watermark)
+        for listener in list(self.blocked_listeners):
+            try:
+                listener(blocked)
+            except Exception:
+                log.exception("blocked listener failed")
+
+    async def wait_memory_gate(self, timeout: float = 0.25) -> None:
+        """One bounded wait for the memory gate. Callers loop on their own
+        liveness condition (connection closing, consumer registration) so a
+        parked publisher still wakes for shutdown and dead-peer teardown."""
+        if not self._memory_gate.is_set():
+            try:
+                await asyncio.wait_for(
+                    asyncio.shield(self._memory_gate.wait()), timeout)
+            except asyncio.TimeoutError:
+                pass
 
     def account_message(self, message: Message) -> None:
         """Count a newly resident message body in the RAM gauge."""
         if message.body is not None and not message.accounted:
             self.account_memory(len(message.body))
             message.accounted = True
+
+    def metrics_snapshot(self) -> dict:
+        """Metrics counters plus broker-level gauges (the resident-memory
+        gauge an operator needs to see passivation/backpressure working)."""
+        snap = self.metrics.snapshot()
+        snap["resident_bytes"] = self.resident_bytes
+        snap["memory_blocked"] = self.blocked
+        snap["memory_high_watermark"] = self.memory_high_watermark
+        return snap
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -95,9 +161,21 @@ class Broker:
             self._sweep_task.cancel()
             self._sweep_task = None
         self._flush_msg_deletes()
+        # paged transient blobs are a passivation convenience, not a
+        # durability promise: delete them on clean shutdown so they can't
+        # accumulate as orphans (crash leftovers do linger, matching the
+        # reference's Cassandra row-TTL story for passivated messages)
+        paged_ids: set[int] = set()
         for vhost in self.vhosts.values():
             for queue in vhost.queues.values():
                 queue.flush_store_buffers()
+                for qm in queue.messages:
+                    msg = qm.message
+                    if msg.paged and not msg.persisted:
+                        msg.paged = False
+                        paged_ids.add(msg.id)
+        if paged_ids:
+            self.store_bg(self.store.delete_messages(list(paged_ids)))
         # let queued background store writes drain before closing
         if self._bg_tasks:
             await asyncio.gather(*self._bg_tasks, return_exceptions=True)
@@ -745,8 +823,9 @@ class Broker:
         if message.refer_count <= 0 and message.accounted:
             self.account_memory(-len(message.body or b""))
             message.accounted = False
-        if message.refer_count <= 0 and message.persisted:
+        if message.refer_count <= 0 and (message.persisted or message.paged):
             message.persisted = False
+            message.paged = False
             # coalesce per loop tick: one executemany instead of a store op
             # per message (ids are snowflakes, never reused, so a delayed
             # delete can't clash with a later insert)

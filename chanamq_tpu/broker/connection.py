@@ -140,6 +140,14 @@ class AMQPConnection:
         # connection's confirmed persistent publishes; passed to
         # flush(intervals=...) so the barrier fails only for our own writes
         self._confirm_marks: list[tuple[int, int]] = []
+        # backpressure: only connections that have published park at the
+        # broker memory gate (consumer-only connections must keep reading
+        # acks or the backlog could never drain — RabbitMQ blocks publishing
+        # connections the same way)
+        self._has_published = False
+        # client announced capabilities.connection.blocked in start-ok:
+        # it wants Connection.Blocked/Unblocked notifications
+        self._supports_blocked = False
 
     # ------------------------------------------------------------------
     # output path
@@ -178,7 +186,9 @@ class AMQPConnection:
                 if self.closing and not self._out:
                     break
         except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
-            pass
+            # dead peer: mark closing so a main loop parked at the memory
+            # gate (not reading, hence blind to the hangup) still exits
+            self.closing = True
 
     def _resume_dispatch(self) -> None:
         for channel in self.channels.values():
@@ -193,6 +203,7 @@ class AMQPConnection:
         """Run the connection to completion."""
         self.broker.metrics.connections_opened += 1
         self._writer_task = asyncio.create_task(self._writer_loop())
+        self.broker.blocked_listeners.add(self._on_memory_blocked)
         try:
             await self._handshake()
             await self._main_loop()
@@ -203,7 +214,22 @@ class AMQPConnection:
         except Exception:
             log.exception("connection %d crashed", self.id)
         finally:
+            self.broker.blocked_listeners.discard(self._on_memory_blocked)
             await self._teardown()
+
+    def _on_memory_blocked(self, blocked: bool) -> None:
+        """Broker memory gate transition: notify clients that announced the
+        connection.blocked capability (exceeds the reference, which never
+        implemented Blocked/Unblocked — README.md:10-22)."""
+        if self._supports_blocked and self._opened and not self.closing:
+            if blocked:
+                self.send_method(0, am.Connection.Blocked(
+                    reason="memory high watermark"))
+            else:
+                self.send_method(0, am.Connection.Unblocked())
+
+    def _has_consumers(self) -> bool:
+        return any(ch.consumers for ch in self.channels.values())
 
     async def _read_chunk(self) -> bytes:
         data = await self.reader.read(65536)
@@ -229,6 +255,17 @@ class AMQPConnection:
 
     async def _main_loop(self) -> None:
         while not self.closing:
+            # inbound backpressure: above the memory high watermark, pure
+            # publishers stop being read (their bytes back up into TCP)
+            # until the gate reopens below the low watermark. Connections
+            # with consumers keep being read — pausing them would starve
+            # the very acks that drain memory. The bounded gate wait keeps
+            # the loop responsive to closing (server stop, dead peer).
+            while (self._has_published and self.broker.blocked
+                   and not self.closing and not self._has_consumers()):
+                await self.broker.wait_memory_gate()
+            if self.closing:
+                return
             data = await self._read_chunk()
             for item in self._parser.feed(data):
                 if isinstance(item, FrameError):
@@ -360,6 +397,14 @@ class AMQPConnection:
                 if now - self._last_send >= interval / 2:
                     self.send_bytes(HEARTBEAT_BYTES)
                 if now - self._last_recv > 2 * interval:
+                    if (self.broker.blocked and self._has_published
+                            and not self._has_consumers()):
+                        # we stopped reading this publisher at the memory
+                        # gate — its heartbeats are sitting unread in the
+                        # TCP buffer, so silence is not death. A dead peer
+                        # is still reaped: our outbound heartbeats keep
+                        # probing and a send failure closes the connection.
+                        continue
                     log.warning("connection %d heartbeat timeout", self.id)
                     self.closing = True
                     self.writer.close()
@@ -424,6 +469,10 @@ class AMQPConnection:
             if not ok:
                 raise HardError(ErrorCode.ACCESS_REFUSED, "authentication failed")
             self._authenticated = True
+            capabilities = (method.client_properties or {}).get("capabilities")
+            if isinstance(capabilities, dict):
+                self._supports_blocked = bool(
+                    capabilities.get("connection.blocked"))
             self.send_method(0, am.Connection.Tune(
                 channel_max=self.cfg_channel_max,
                 frame_max=self.cfg_frame_max,
@@ -743,6 +792,7 @@ class AMQPConnection:
     async def _on_publish(self, channel: ServerChannel, command: AMQCommand) -> None:
         method = command.method
         props = command.properties or BasicProperties()
+        self._has_published = True
         seq = None
         if channel.mode == ChannelMode.CONFIRM:
             channel.publish_seq += 1

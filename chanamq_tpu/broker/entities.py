@@ -31,6 +31,7 @@ from collections import deque
 from typing import TYPE_CHECKING, Any, Callable, Optional
 
 from ..amqp.properties import BasicProperties
+from ..store.api import StoredMessage
 from .matchers import Matcher, matcher_for
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -51,7 +52,7 @@ class Message:
     __slots__ = (
         "id", "properties", "body", "exchange", "routing_key",
         "ttl_ms", "refer_count", "persisted", "published_ns", "header_raw",
-        "accounted",
+        "accounted", "paged",
     )
 
     def __init__(
@@ -79,6 +80,11 @@ class Message:
         # body bytes counted in Broker.resident_bytes (cleared on
         # passivation / final unrefer so accounting never double-releases)
         self.accounted = False
+        # blob written to the store ONLY for passivation (transient message
+        # paged out under memory pressure) — deleted at refcount 0 like a
+        # persisted blob, but never promised durable: no queue-log/unack
+        # rows are written for it and recovery never resurrects it
+        self.paged = False
 
     def header_payload(self) -> bytes:
         hp = self.header_raw
@@ -220,21 +226,35 @@ class Queue:
                     qm.body_size, qm.expire_at_ms,
                 )
             )
-            # deep-backlog passivation (reference: MessageEntity pages
-            # inactive bodies out, MessageEntity.scala:168-198): beyond the
-            # per-queue resident watermark, drop the body from RAM — the
-            # store already holds it (the blob insert was enqueued at publish
-            # and rides the same FIFO store queue, so hydration reads always
-            # see it) and dispatch hydrates it back on demand.
-            max_resident = self.broker.queue_max_resident
-            if (max_resident and len(self.messages) > max_resident
-                    and message.body is not None):
-                if message.accounted:
-                    self.broker.account_memory(-len(message.body))
-                    message.accounted = False
-                # only the body pages out; properties/header_raw stay so a
-                # hydrated delivery needs just the blob read
-                message.body = None
+        # deep-backlog passivation (reference: MessageEntity pages ANY
+        # inactive body out — transient included — persisting it first,
+        # MessageEntity.scala:171-186): beyond the per-queue resident
+        # watermark, drop the body from RAM. Persistent bodies are already
+        # in the store (the blob insert was enqueued at publish and rides
+        # the same FIFO store queue, so hydration reads always see it);
+        # transient bodies are written now, flagged paged-not-persisted so
+        # no durability promise attaches and recovery never resurrects
+        # them. Dispatch hydrates either kind back on demand.
+        max_resident = self.broker.queue_max_resident
+        if (max_resident and len(self.messages) > max_resident
+                and message.body is not None):
+            if not (message.persisted or message.paged):
+                message.paged = True
+                self.broker.store_bg(self.broker.store.insert_message(
+                    StoredMessage(
+                        id=message.id,
+                        properties_raw=message.header_payload(),
+                        body=message.body, exchange=message.exchange,
+                        routing_key=message.routing_key,
+                        refer_count=message.refer_count,
+                        ttl_ms=message.ttl_ms,
+                    )))
+            if message.accounted:
+                self.broker.account_memory(-len(message.body))
+                message.accounted = False
+            # only the body pages out; properties/header_raw stay so a
+            # hydrated delivery needs just the blob read
+            message.body = None
         self.schedule_dispatch()
         return qm
 

@@ -87,6 +87,13 @@ class BrokerServer:
                 connection.writer.close()
             except Exception:
                 pass
+        # explicitly await per-connection teardown: a handler parked at the
+        # memory gate wakes on its next bounded wait and must finish before
+        # the loop goes away (Server.wait_closed alone doesn't guarantee it)
+        if self._connections:
+            await asyncio.gather(
+                *(c.closed for c in list(self._connections)),
+                return_exceptions=True)
         for server in self._servers:
             await server.wait_closed()
         self._servers.clear()
@@ -127,10 +134,14 @@ class BrokerServer:
             tls_port = config.int("chana.mq.amqp.amqps.port")
         heartbeat = config.duration_s("chana.mq.amqp.connection.heartbeat")
         sweep = config.duration_s("chana.mq.message.sweep-interval")
+        low = config.size_bytes("chana.mq.memory.low-watermark")
         broker = Broker(
             store=store,
             message_sweep_interval_s=sweep if sweep is not None else 0.0,
             queue_max_resident=config.int("chana.mq.queue.max-resident"),
+            memory_high_watermark=config.size_bytes(
+                "chana.mq.memory.high-watermark") or 0,
+            memory_low_watermark=low,
         )
         return cls(
             broker=broker,

@@ -111,6 +111,8 @@ class AMQPClient:
         self._conn_waiters: list[tuple[tuple[type, ...], asyncio.Future]] = []
         self.closed = False
         self._close_exc: Optional[Exception] = None
+        # last Connection.Blocked/Unblocked notification from the server
+        self.server_blocked = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -140,7 +142,11 @@ class AMQPClient:
         response = b"\x00" + username.encode() + b"\x00" + password.encode() \
             if mech == b"PLAIN" else b""
         self._send_method(0, am.Connection.StartOk(
-            client_properties=client_properties or {"product": "chanamq-tpu-client"},
+            client_properties=client_properties or {
+                "product": "chanamq-tpu-client",
+                # opt in to Connection.Blocked/Unblocked notifications
+                "capabilities": {"connection.blocked": True},
+            },
             mechanism=mech.decode(), response=response, locale="en_US",
         ))
         tune = await self._wait_connection_method((am.Connection.Tune,))
@@ -342,6 +348,12 @@ class AMQPClient:
                 self._send_method(0, am.Connection.CloseOk())
                 await self._shutdown(
                     ConnectionClosedError(method.reply_code, method.reply_text))
+                return
+            if isinstance(method, am.Connection.Blocked):
+                self.server_blocked = True
+                return
+            if isinstance(method, am.Connection.Unblocked):
+                self.server_blocked = False
                 return
             for i, (types, fut) in enumerate(self._conn_waiters):
                 if isinstance(method, types) and not fut.done():

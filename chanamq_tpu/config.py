@@ -51,6 +51,12 @@ DEFAULTS: dict[str, Any] = {
     # MessageEntity.scala:168-198, recast from age-based to depth-based).
     # 0 disables passivation.
     "chana.mq.queue.max-resident": 16384,
+    # inbound publisher backpressure: above high-watermark resident message
+    # bytes, publishing connections stop being read (and capable clients get
+    # Connection.Blocked) until the gauge falls below low-watermark.
+    # 0 / null disables the gate (per-queue passivation still bounds memory).
+    "chana.mq.memory.high-watermark": "512MiB",
+    "chana.mq.memory.low-watermark": None,  # default: 80% of high
     "chana.mq.admin.enabled": True,
     "chana.mq.admin.interface": "127.0.0.1",
     "chana.mq.admin.port": 15672,
@@ -93,7 +99,9 @@ def parse_duration_s(value: Any) -> Optional[float]:
     return float(match.group(1)) * _DURATION_UNITS.get(match.group(2) or "s", 1.0)
 
 
-def parse_size_bytes(value: Any) -> int:
+def parse_size_bytes(value: Any) -> Optional[int]:
+    if value is None:
+        return None
     if isinstance(value, (int, float)):
         return int(value)
     match = _SIZE_RE.match(str(value))
@@ -166,7 +174,7 @@ class Config:
     def duration_s(self, path: str) -> Optional[float]:
         return parse_duration_s(self._values[path])
 
-    def size_bytes(self, path: str) -> int:
+    def size_bytes(self, path: str) -> Optional[int]:
         return parse_size_bytes(self._values[path])
 
     def list(self, path: str) -> list:

@@ -107,7 +107,7 @@ class AdminServer:
                 return "405 Method Not Allowed", {"error": "use GET"}
             # observability
             if segments == ["metrics"]:
-                return "200 OK", self.broker.metrics.snapshot()
+                return "200 OK", self.broker.metrics_snapshot()
             if segments == ["overview"]:
                 return "200 OK", self._overview()
             if len(segments) == 2 and segments[0] == "queues":
@@ -131,7 +131,7 @@ class AdminServer:
                 }
                 for name, vhost in self.broker.vhosts.items()
             },
-            "metrics": self.broker.metrics.snapshot(),
+            "metrics": self.broker.metrics_snapshot(),
         }
 
     def _queues(self, vhost_name: str) -> list:

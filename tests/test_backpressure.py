@@ -1,0 +1,157 @@
+"""Inbound publisher backpressure + bounded memory under hostile load.
+
+VERDICT r3 #2: a fast publisher of transient messages into a consumerless
+queue must not grow RAM without bound. Two mechanisms compose:
+
+- per-queue depth passivation pages transient bodies to the store
+  (tests in test_passivation.py);
+- the broker-wide memory gate stops READING publishing connections above
+  chana.mq.memory.high-watermark and resumes below the low watermark,
+  sending Connection.Blocked/Unblocked to capable clients (exceeds the
+  reference, which never implemented them — README.md:10-22; its
+  backpressure was akka-streams demand + TCP, SURVEY.md §7.3).
+"""
+
+import asyncio
+
+import pytest
+
+from chanamq_tpu.broker.broker import Broker
+from chanamq_tpu.broker.server import BrokerServer
+from chanamq_tpu.client import AMQPClient
+from chanamq_tpu.rest.admin import AdminServer
+from chanamq_tpu.store.sqlite import SqliteStore
+
+pytestmark = pytest.mark.asyncio
+
+BODY = b"z" * 1024
+
+
+async def wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not predicate():
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError("condition not reached in time")
+        await asyncio.sleep(interval)
+
+
+async def test_transient_flood_bounded_resident_no_disconnect(tmp_path):
+    """The VERDICT acceptance test: flood transient messages into a
+    consumerless queue; resident_bytes stays bounded, the connection stays
+    up, and the gauge is visible via /admin/metrics."""
+    broker = Broker(store=SqliteStore(str(tmp_path / "bp.db")),
+                    queue_max_resident=8)
+    srv = BrokerServer(broker=broker, host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    admin = AdminServer(broker, host="127.0.0.1", port=0)
+    await admin.start()
+    c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    ch = await c.channel()
+    await ch.queue_declare("flood_q", durable=True)
+
+    n = 300
+    for _ in range(n):
+        ch.basic_publish(BODY, routing_key="flood_q")  # transient
+
+    queue = broker.vhosts["/"].queues["flood_q"]
+    await wait_for(lambda: len(queue.messages) == n)
+    # bounded: at most watermark+1 resident bodies (plus slack for the
+    # in-flight page-out pass), not n
+    assert broker.resident_bytes <= 16 * len(BODY), broker.resident_bytes
+    assert not c.closed  # no disconnect
+
+    # the gauge is exported on /admin/metrics
+    reader, writer = await asyncio.open_connection("127.0.0.1", admin.bound_port)
+    writer.write(b"GET /admin/metrics HTTP/1.1\r\n\r\n")
+    raw = await asyncio.wait_for(reader.read(-1), 10)
+    writer.close()
+    import json
+
+    payload = json.loads(raw.split(b"\r\n\r\n", 1)[1])
+    assert payload["resident_bytes"] == broker.resident_bytes
+    assert payload["memory_blocked"] is False
+
+    # everything is still consumable, in order, bodies intact
+    got = 0
+    while True:
+        m = await ch.basic_get("flood_q", no_ack=True)
+        if m is None:
+            break
+        assert m.body == BODY
+        got += 1
+    assert got == n
+    await c.close()
+    await admin.stop()
+    await srv.stop()
+
+
+async def test_memory_gate_blocks_and_unblocks_publisher(tmp_path):
+    """Above the high watermark the broker stops reading the publisher and
+    sends Connection.Blocked; after a consumer drains below the low
+    watermark it resumes and sends Unblocked; nothing is lost."""
+    broker = Broker(store=SqliteStore(str(tmp_path / "gate.db")),
+                    queue_max_resident=0,          # passivation off: force
+                    memory_high_watermark=20 * 1024,  # the gate to do the work
+                    memory_low_watermark=4 * 1024)
+    srv = BrokerServer(broker=broker, host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+
+    pub = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    pch = await pub.channel()
+    await pch.queue_declare("gate_q")
+
+    n = 120  # 120 KiB >> 20 KiB high watermark
+    for _ in range(n):
+        pch.basic_publish(BODY, routing_key="gate_q")
+
+    await wait_for(lambda: broker.blocked)
+    # capable client got Connection.Blocked
+    await wait_for(lambda: pub.server_blocked)
+    assert not pub.closed
+    blocked_at = broker.resident_bytes
+    assert blocked_at > broker.memory_high_watermark
+
+    # a consumer-only connection is NOT gated: it can drain
+    con = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    cch = await con.channel()
+    received = []
+
+    def cb(msg):
+        received.append(msg)
+
+    await cch.basic_consume("gate_q", cb, no_ack=True)
+    # draining lowers resident bytes below low watermark -> gate reopens,
+    # the parked publisher connection resumes reading, the rest flows
+    await wait_for(lambda: len(received) == n, timeout=30)
+    await wait_for(lambda: not broker.blocked)
+    await wait_for(lambda: not pub.server_blocked)
+
+    # the unblocked publisher works again end-to-end
+    pch.basic_publish(b"after", routing_key="gate_q")
+    await wait_for(lambda: len(received) == n + 1)
+    assert received[-1].body == b"after"
+    assert all(m.body == BODY for m in received[:n])
+
+    await pub.close()
+    await con.close()
+    await srv.stop()
+
+
+async def test_server_stop_while_publisher_gated(tmp_path):
+    """Review regression: BrokerServer.stop() must not deadlock on a
+    publisher parked at the memory gate (the bounded gate wait re-checks
+    closing)."""
+    broker = Broker(store=SqliteStore(str(tmp_path / "stop.db")),
+                    queue_max_resident=0,
+                    memory_high_watermark=8 * 1024,
+                    memory_low_watermark=2 * 1024)
+    srv = BrokerServer(broker=broker, host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    pub = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    pch = await pub.channel()
+    await pch.queue_declare("stop_q")
+    for _ in range(32):
+        pch.basic_publish(BODY, routing_key="stop_q")
+    await wait_for(lambda: broker.blocked)
+    await asyncio.wait_for(srv.stop(), 10)  # used to hang forever
+    await pub.close()
