@@ -180,6 +180,17 @@ async def setup_topology(port: int, persistent: bool) -> None:
     await c.close()
 
 
+def _tail(path: str, limit: int = 2000) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - limit))
+            return f.read().decode("utf-8", "replace").strip()
+    except OSError:
+        return ""
+
+
 def run_spec(name: str, rate: int = 0) -> dict:
     if name == PACED_SPEC:
         auto_ack, persistent, producers, consumers = True, False, 1, 1
@@ -196,19 +207,25 @@ def run_spec(name: str, rate: int = 0) -> dict:
         tmp.close()
         store_file = tmp.name
         broker_args += ["--store", store_file]
+    # Broker stderr goes to a file so a failed spec can report the tail
+    # instead of an opaque crash (the round-2 postmortem's ask).
+    broker_log = tempfile.NamedTemporaryFile(
+        suffix=".log", prefix="bench-broker-", delete=False)
     broker = subprocess.Popen(broker_args, env=env,
-                              stdout=subprocess.DEVNULL,
-                              stderr=subprocess.DEVNULL)
+                              stdout=broker_log, stderr=broker_log)
+    children = []
+    errors: list[str] = []
+    outputs: list[dict] = []
+    elapsed = 0.0
     try:
         wait_port(port)
         asyncio.run(setup_topology(port, persistent))
-        children = []
         for _ in range(consumers):
             children.append(subprocess.Popen(
                 [sys.executable, __file__, "--role", "consumer",
                  "--port", str(port), "--auto-ack", str(int(auto_ack)),
                  "--seconds", str(BENCH_SECONDS)],
-                env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL))
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
         time.sleep(0.3)
         t0 = time.perf_counter()
         for _ in range(producers):
@@ -216,20 +233,65 @@ def run_spec(name: str, rate: int = 0) -> dict:
                 [sys.executable, __file__, "--role", "producer",
                  "--port", str(port), "--persistent", str(int(persistent)),
                  "--seconds", str(BENCH_SECONDS), "--rate", str(rate)],
-                env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL))
-        outputs = []
-        for child in children:
-            out, _ = child.communicate(timeout=BENCH_SECONDS + 60)
-            outputs.append(json.loads(out.decode().strip().splitlines()[-1]))
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        for i, child in enumerate(children):
+            role = "consumer" if i < consumers else "producer"
+            try:
+                out, err = child.communicate(timeout=BENCH_SECONDS + 60)
+            except subprocess.TimeoutExpired:
+                child.kill()
+                _, err = child.communicate()
+                err_lines = err.decode("utf-8", "replace").strip().splitlines()
+                tail = f": {err_lines[-1][:300]}" if err_lines else ""
+                errors.append(f"{role}[{i}] timed out{tail}")
+                continue  # post-kill partial stdout is not a valid result
+            lines = out.decode().strip().splitlines()
+            if child.returncode != 0 or not lines:
+                err_lines = err.decode("utf-8", "replace").strip().splitlines()
+                tail = err_lines[-1][:300] if err_lines else "no output"
+                errors.append(f"{role}[{i}] rc={child.returncode}: {tail}")
+                continue
+            try:
+                outputs.append(json.loads(lines[-1]))
+            except ValueError:
+                errors.append(f"{role}[{i}] bad output: {lines[-1][:200]}")
         elapsed = time.perf_counter() - t0
+    except Exception as exc:  # noqa: BLE001 — a red spec must stay parseable
+        for child in children:
+            if child.poll() is None:
+                child.kill()
+        errors.append(f"{type(exc).__name__}: {exc}")
     finally:
         broker.terminate()
-        broker.wait(timeout=10)
+        try:
+            broker.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            broker.kill()
+            broker.wait()
+        broker_log.close()
         if store_file:
             try:
                 os.unlink(store_file)
             except OSError:
                 pass
+    if broker.returncode not in (0, -15):
+        errors.append(f"broker rc={broker.returncode}")
+    if errors:
+        result = {"error": "; ".join(errors)}
+        tail = _tail(broker_log.name)
+        if tail:
+            result["broker_stderr_tail"] = tail[-800:]
+        if outputs:  # partial results still help diagnosis
+            result["partial_outputs"] = outputs
+        try:
+            os.unlink(broker_log.name)
+        except OSError:
+            pass
+        return result
+    try:
+        os.unlink(broker_log.name)
+    except OSError:
+        pass
     published = sum(o.get("published", 0) for o in outputs)
     delivered = sum(o.get("delivered", 0) for o in outputs)
     p99s = [o["p99_us"] for o in outputs if o.get("p99_us") is not None]
@@ -284,22 +346,25 @@ def main() -> None:
         # paced latency run at ~25% of the measured headline throughput
         paced_rate = int(os.environ.get(
             "BENCH_PACED_RATE",
-            max(1000, int(headline["delivered_per_s"] * 0.25))))
+            max(1000, int(headline.get("delivered_per_s", 0) * 0.25))))
         results[PACED_SPEC] = run_spec(PACED_SPEC, rate=paced_rate)
         results[PACED_SPEC]["rate"] = paced_rate
         print(f"# {PACED_SPEC}: {results[PACED_SPEC]}", file=sys.stderr)
     line = {
         "metric": "amqp_delivered_msgs_per_s_transient_autoack_3p3c",
-        "value": headline["delivered_per_s"],
+        "value": headline.get("delivered_per_s"),
         "unit": "msgs/s",
         "vs_baseline": None,  # reference published no numbers (BASELINE.md)
-        "p99_publish_to_deliver_us": headline["p99_us"],
+        "p99_publish_to_deliver_us": headline.get("p99_us"),
         "paced_p50_us": results.get(PACED_SPEC, {}).get("p50_us"),
         "paced_p99_us": results.get(PACED_SPEC, {}).get("p99_us"),
         "body_bytes": BODY_BYTES,
         "seconds": BENCH_SECONDS,
         "specs": results,
     }
+    spec_errors = {n: r["error"] for n, r in results.items() if "error" in r}
+    if spec_errors:
+        line["error"] = spec_errors
     print(json.dumps(line))
 
 

@@ -15,6 +15,7 @@ state with explicit, strictly-ordered async store writes:
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 from typing import Any, Awaitable, Optional
 
@@ -125,8 +126,10 @@ class Broker:
         parked publisher still wakes for shutdown and dead-peer teardown."""
         if not self._memory_gate.is_set():
             try:
-                await asyncio.wait_for(
-                    asyncio.shield(self._memory_gate.wait()), timeout)
+                # no shield: cancelling Event.wait() is harmless, and a
+                # shielded inner task would leak one pending task per
+                # timeout tick for every parked publisher
+                await asyncio.wait_for(self._memory_gate.wait(), timeout)
             except asyncio.TimeoutError:
                 pass
 
@@ -169,7 +172,13 @@ class Broker:
         for vhost in self.vhosts.values():
             for queue in vhost.queues.values():
                 queue.flush_store_buffers()
-                for qm in queue.messages:
+                # unacked deliveries hold paged messages too (a delivered-
+                # but-unacked transient that was paged before hydration
+                # would otherwise leave a permanent orphan blob when stop()
+                # is called without connection teardown requeueing it first)
+                for qm in itertools.chain(
+                        queue.messages,
+                        (d.queued for d in queue.outstanding.values())):
                     msg = qm.message
                     if msg.paged and not msg.persisted:
                         msg.paged = False
@@ -183,11 +192,10 @@ class Broker:
         self._started = False
 
     def store_bg(self, aw: Awaitable[None]) -> None:
-        """Fire-and-forget store write. The SQLite backend enqueues ops
-        synchronously at call time (group-commit queue), so program order ==
-        store order; this wrapper only tracks completion and logs failures.
-        MemoryStore coroutines are wrapped into tasks (created in call order,
-        still FIFO)."""
+        """Fire-and-forget store write. Both built-in backends apply ops
+        synchronously at call time (SQLite enqueues into its group-commit
+        queue, MemoryStore mutates eagerly), so program order == store
+        order; this wrapper only tracks completion and logs failures."""
         task = asyncio.ensure_future(aw)  # type: ignore[arg-type]
         self._bg_tasks.add(task)
         task.add_done_callback(self._bg_done)
@@ -268,6 +276,10 @@ class Broker:
                 self.account_message(message)
             qm = QueuedMessage(message, offset, expire_at, body_size=size)
             queue.messages.append(qm)
+            if message.body is None:
+                # deep-tail entry recovered without its blob: register it
+                # for batch hydration just like a live passivation would
+                queue._passivated.append(qm)
             max_offset = max(max_offset, offset)
         queue.next_offset = max_offset + 1
         if sq.unacks:

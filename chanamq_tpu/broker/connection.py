@@ -263,6 +263,12 @@ class AMQPConnection:
             # the loop responsive to closing (server stop, dead peer).
             while (self._has_published and self.broker.blocked
                    and not self.closing and not self._has_consumers()):
+                # the peer isn't being read while parked: refresh the
+                # heartbeat clock every gate tick (not merely after the
+                # park ends — the heartbeat timer can fire in the gap
+                # between gate reopen and this task resuming, and would
+                # otherwise kill a healthy connection on a stale clock)
+                self._last_recv = time.monotonic()
                 await self.broker.wait_memory_gate()
             if self.closing:
                 return

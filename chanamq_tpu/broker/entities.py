@@ -186,6 +186,13 @@ class Queue:
         # passivation: an async head-hydration pass is in flight
         self._hydrating = False
         self._hydrate_task: Optional[asyncio.Task] = None
+        # entries this queue paged out, in offset order, so a hydration
+        # pass is O(batch) instead of rescanning the resident prefix of
+        # self.messages; entries hydrated/dropped by other paths are
+        # lazily skipped. A fanout sibling can page a shared body without
+        # touching this deque — _hydrate_head falls back to a full scan
+        # when the passivated head isn't covered.
+        self._passivated: deque[QueuedMessage] = deque()
 
     # -- introspection ----------------------------------------------------
 
@@ -255,6 +262,7 @@ class Queue:
             # only the body pages out; properties/header_raw stay so a
             # hydrated delivery needs just the blob read
             message.body = None
+            self._passivated.append(qm)
         self.schedule_dispatch()
         return qm
 
@@ -367,18 +375,45 @@ class Queue:
         self._hydrate_task = asyncio.get_event_loop().create_task(
             self._hydrate_head())
 
+    def _collect_hydrate_targets(self) -> list[QueuedMessage]:
+        """Pop the next hydration batch off the passivated deque, lazily
+        discarding entries already settled by other paths (hydrated via
+        basic_get, dead, purged/final-unreferred)."""
+        targets: list[QueuedMessage] = []
+        while self._passivated and len(targets) < self.HYDRATE_BATCH:
+            qm = self._passivated[0]
+            if qm.dead or qm.message.refer_count <= 0:
+                self._passivated.popleft()
+                continue
+            if qm.message.body is not None:
+                self._passivated.popleft()
+                continue
+            targets.append(self._passivated.popleft())
+        return targets
+
     async def _hydrate_head(self) -> None:
         """Batch-reattach passivated bodies at the queue head from the store.
         Entries whose blob is gone (TTL'd / deleted) are marked dead and
         discarded by the next _expire_head pass."""
         failed = False
+        targets: list[QueuedMessage] = []
         try:
-            targets = []
-            for qm in self.messages:
-                if len(targets) >= self.HYDRATE_BATCH:
-                    break
-                if qm.message.body is None and not qm.dead:
-                    targets.append(qm)
+            targets = self._collect_hydrate_targets()
+            head = self.messages[0] if self.messages else None
+            if (head is not None and head.message.body is None
+                    and not head.dead
+                    and (not targets or targets[0] is not head)):
+                # the passivated head isn't covered by our own deque: a
+                # fanout sibling paged the shared body out from under us
+                # (entities.py push nulls message.body for every routed
+                # queue). Full scan of the resident prefix — rare path.
+                self._passivated.extendleft(reversed(targets))
+                targets = []
+                for qm in self.messages:
+                    if len(targets) >= self.HYDRATE_BATCH:
+                        break
+                    if qm.message.body is None and not qm.dead:
+                        targets.append(qm)
             if not targets:
                 return
             stored = await self.broker.store.select_messages(
@@ -403,6 +438,10 @@ class Queue:
                     msg.accounted = True
         except Exception:
             failed = True
+            # return unfinished targets so the retry pass finds them again
+            # (duplicates vs fallback-scanned entries are lazily skipped
+            # once hydrated)
+            self._passivated.extendleft(reversed(targets))
             log.exception("hydration of queue %s failed; retrying in 1s",
                           self.name)
         finally:
@@ -546,6 +585,7 @@ class Queue:
             self._advance_watermark(qm)
             self.broker.unrefer(qm.message)
         self.messages.clear()
+        self._passivated.clear()
         if self.durable:
             self.broker.store_bg(
                 self.broker.store.purge_queue_msgs(self.vhost, self.name)

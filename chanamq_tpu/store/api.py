@@ -12,6 +12,7 @@ CassandraOpService.scala:753-755 — a scar SURVEY.md §7.3 says to avoid).
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -110,10 +111,20 @@ class StoreService:
         return out
 
     async def select_message_metas(self, msg_ids: list[int]) -> dict[int, StoredMessage]:
-        """Batch metadata read: like select_messages but backends may omit
-        the body (body=None) — recovery uses it to rebuild deep backlogs
-        without reading every blob."""
-        return await self.select_messages(msg_ids)
+        """Batch metadata read: like select_messages but bodies are omitted
+        (body=None) — recovery uses it to rebuild deep backlogs without
+        holding every blob in RAM. The default strips bodies after a full
+        read so every backend keeps the contract; backends that can skip
+        the body column entirely (SqliteStore) override it and also avoid
+        the blob I/O."""
+        full = await self.select_messages(msg_ids)
+        # strip into fresh copies: select_messages makes no promise that
+        # the returned objects aren't the backend's own cached instances,
+        # so mutating them in place could corrupt the store
+        return {
+            mid: dataclasses.replace(meta, body=None)  # type: ignore[arg-type]
+            for mid, meta in full.items()
+        }
 
     async def delete_message(self, msg_id: int) -> None:
         raise NotImplementedError

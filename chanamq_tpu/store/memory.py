@@ -3,6 +3,15 @@
 Functionally complete (everything select_queue / recovery needs works), just
 not durable across process restarts. Useful for unit tests and as the broker
 default when no store is configured.
+
+Write methods take effect at CALL time and return an already-completed
+awaitable, mirroring SqliteStore._submit's enqueue-at-call-time property:
+program order == store order regardless of when (or whether) the caller
+awaits. This matters for correctness, not just symmetry — the broker pages
+message bodies out via fire-and-forget store_bg(insert_message(...)) and a
+pipelined basic.get may read the blob back with zero event-loop yields in
+between; a lazily-run write task would make that read miss a just-paged
+message.
 """
 
 from __future__ import annotations
@@ -11,6 +20,18 @@ import copy
 from typing import Optional
 
 from .api import StoredExchange, StoredMessage, StoredQueue, StoreService
+
+
+class _Done:
+    """Already-completed awaitable returned by eager write methods."""
+
+    __slots__ = ()
+
+    def __await__(self):
+        return iter(())
+
+
+_DONE = _Done()
 
 
 class MemoryStore(StoreService):
@@ -30,34 +51,39 @@ class MemoryStore(StoreService):
 
     # -- messages ---------------------------------------------------------
 
-    async def insert_message(self, msg: StoredMessage) -> None:
+    def insert_message(self, msg: StoredMessage):
         self.messages[msg.id] = copy.copy(msg)
+        return _DONE
 
     async def select_message(self, msg_id: int) -> Optional[StoredMessage]:
         msg = self.messages.get(msg_id)
         return copy.copy(msg) if msg else None
 
-    async def delete_message(self, msg_id: int) -> None:
+    def delete_message(self, msg_id: int):
         self.messages.pop(msg_id, None)
+        return _DONE
 
-    async def delete_messages(self, msg_ids) -> None:
+    def delete_messages(self, msg_ids):
         for msg_id in msg_ids:
             self.messages.pop(msg_id, None)
+        return _DONE
 
-    async def update_message_refer_count(self, msg_id: int, count: int) -> None:
+    def update_message_refer_count(self, msg_id: int, count: int):
         msg = self.messages.get(msg_id)
         if msg:
             msg.refer_count = count
+        return _DONE
 
     # -- queue meta -------------------------------------------------------
 
-    async def insert_queue_meta(self, q: StoredQueue) -> None:
+    def insert_queue_meta(self, q: StoredQueue):
         existing = self.queues.get((q.vhost, q.name))
         stored = copy.deepcopy(q)
         if existing:
             stored.msgs = existing.msgs
             stored.unacks = existing.unacks
         self.queues[(q.vhost, q.name)] = stored
+        return _DONE
 
     async def select_queue(self, vhost: str, name: str) -> Optional[StoredQueue]:
         q = self.queues.get((vhost, name))
@@ -72,59 +98,68 @@ class MemoryStore(StoreService):
 
     # -- queue log --------------------------------------------------------
 
-    async def insert_queue_msg(self, vhost, queue, offset, msg_id, body_size, expire_at_ms) -> None:
+    def insert_queue_msg(self, vhost, queue, offset, msg_id, body_size, expire_at_ms):
         q = self.queues.get((vhost, queue))
         if q:
             q.msgs.append((offset, msg_id, body_size, expire_at_ms))
+        return _DONE
 
-    async def delete_queue_msg(self, vhost, queue, offset) -> None:
+    def delete_queue_msg(self, vhost, queue, offset):
         q = self.queues.get((vhost, queue))
         if q:
             q.msgs = [m for m in q.msgs if m[0] != offset]
+        return _DONE
 
     # -- watermark + unacks ------------------------------------------------
 
-    async def update_queue_last_consumed(self, vhost, queue, last_consumed) -> None:
+    def update_queue_last_consumed(self, vhost, queue, last_consumed):
         q = self.queues.get((vhost, queue))
         if q:
             q.last_consumed = last_consumed
             q.msgs = [m for m in q.msgs if m[0] > last_consumed]
+        return _DONE
 
-    async def insert_queue_unacks(self, vhost, queue, unacks) -> None:
+    def insert_queue_unacks(self, vhost, queue, unacks):
         q = self.queues.get((vhost, queue))
         if q:
             for msg_id, offset, body_size, expire_at_ms in unacks:
                 q.unacks[msg_id] = (offset, body_size, expire_at_ms)
+        return _DONE
 
-    async def delete_queue_unacks(self, vhost, queue, msg_ids) -> None:
+    def delete_queue_unacks(self, vhost, queue, msg_ids):
         q = self.queues.get((vhost, queue))
         if q:
             for msg_id in msg_ids:
                 q.unacks.pop(msg_id, None)
+        return _DONE
 
     # -- delete/archive ----------------------------------------------------
 
-    async def archive_queue(self, vhost, queue) -> None:
+    def archive_queue(self, vhost, queue):
         q = self.queues.get((vhost, queue))
         if q:
             self.archived[(vhost, queue)] = copy.deepcopy(q)
+        return _DONE
 
-    async def delete_queue(self, vhost, queue) -> None:
+    def delete_queue(self, vhost, queue):
         self.queues.pop((vhost, queue), None)
+        return _DONE
 
-    async def purge_queue_msgs(self, vhost, queue) -> None:
+    def purge_queue_msgs(self, vhost, queue):
         q = self.queues.get((vhost, queue))
         if q:
             q.msgs = []
+        return _DONE
 
     # -- exchanges + binds -------------------------------------------------
 
-    async def insert_exchange(self, ex: StoredExchange) -> None:
+    def insert_exchange(self, ex: StoredExchange):
         existing = self.exchanges.get((ex.vhost, ex.name))
         stored = copy.deepcopy(ex)
         if existing:
             stored.binds = existing.binds
         self.exchanges[(ex.vhost, ex.name)] = stored
+        return _DONE
 
     async def select_exchange(self, vhost, name) -> Optional[StoredExchange]:
         ex = self.exchanges.get((vhost, name))
@@ -137,27 +172,31 @@ class MemoryStore(StoreService):
             if vhost is None or vh == vhost
         ]
 
-    async def delete_exchange(self, vhost, name) -> None:
+    def delete_exchange(self, vhost, name):
         self.exchanges.pop((vhost, name), None)
+        return _DONE
 
-    async def insert_bind(self, vhost, exchange, queue, routing_key, arguments) -> None:
+    def insert_bind(self, vhost, exchange, queue, routing_key, arguments):
         ex = self.exchanges.get((vhost, exchange))
         if ex is not None:
             entry = (routing_key, queue, arguments)
             if entry not in ex.binds:
                 ex.binds.append(entry)
+        return _DONE
 
-    async def delete_bind(self, vhost, exchange, queue, routing_key) -> None:
+    def delete_bind(self, vhost, exchange, queue, routing_key):
         ex = self.exchanges.get((vhost, exchange))
         if ex is not None:
             ex.binds = [
                 b for b in ex.binds if not (b[0] == routing_key and b[1] == queue)
             ]
+        return _DONE
 
-    async def delete_queue_binds(self, vhost, queue) -> None:
+    def delete_queue_binds(self, vhost, queue):
         for (vh, _), ex in self.exchanges.items():
             if vh == vhost:
                 ex.binds = [b for b in ex.binds if b[1] != queue]
+        return _DONE
 
     async def allocate_worker_id(self) -> int:
         self._next_worker_id += 1
@@ -165,11 +204,13 @@ class MemoryStore(StoreService):
 
     # -- vhosts ------------------------------------------------------------
 
-    async def insert_vhost(self, name: str, active: bool = True) -> None:
+    def insert_vhost(self, name: str, active: bool = True):
         self.vhosts[name] = active
+        return _DONE
 
     async def all_vhosts(self) -> list[tuple[str, bool]]:
         return list(self.vhosts.items())
 
-    async def delete_vhost(self, name: str) -> None:
+    def delete_vhost(self, name: str):
         self.vhosts.pop(name, None)
+        return _DONE

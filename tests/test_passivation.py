@@ -271,3 +271,34 @@ async def test_paged_transients_not_resurrected_by_recovery(db_path):
     stored = await srv2.broker.store.select_messages(paged_ids)
     assert stored == {}
     await srv2.stop()
+
+
+async def test_transient_paged_body_visible_to_inline_basic_get():
+    """A paged transient body written fire-and-forget must be readable with
+    ZERO event-loop yields in between: MemoryStore (the default, no --store)
+    applies writes at call time, so a pipelined publish-past-watermark
+    followed immediately by basic.get can't miss the blob and silently drop
+    the message."""
+    from chanamq_tpu.store.memory import MemoryStore
+
+    broker = Broker(store=MemoryStore(), queue_max_resident=2)
+    await broker.start()
+    try:
+        await broker.declare_queue("/", "q", durable=False)
+        for i in range(6):
+            await broker.publish(
+                "/", "", "q", BasicProperties(delivery_mode=1), b"m%d" % i)
+        queue = broker.vhost("/").queues["q"]
+        # tail entries are paged (body in store only)
+        assert any(qm.message.body is None for qm in queue.messages)
+        got = []
+        # same task, no awaits other than basic_get itself (whose store
+        # read must see the eager write)
+        for _ in range(6):
+            qm = await queue.basic_get()
+            assert qm is not None, f"paged message lost after {got}"
+            got.append(bytes(qm.message.body))
+            broker.unrefer(qm.message)
+        assert got == [b"m%d" % i for i in range(6)]
+    finally:
+        await broker.stop()

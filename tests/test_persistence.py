@@ -435,3 +435,51 @@ async def test_group_commit_batches_many_writes(db_path):
     for i in (0, 250, 499):
         assert (await store.select_message(i)) is not None
     await store.close()
+
+
+# ---------------------------------------------------------------------------
+# store API contract: metas strip bodies; MemoryStore writes are eager
+# ---------------------------------------------------------------------------
+
+
+async def test_select_message_metas_strips_bodies_for_any_backend(db_path):
+    """select_message_metas must never return bodies: recovery counts on
+    rebuilding deep backlogs without blob bytes in RAM, for every backend
+    (the SQLite override also skips the blob read; the base default strips
+    after the fact so third-party stores keep the contract)."""
+    from chanamq_tpu.store.memory import MemoryStore
+
+    for store in (MemoryStore(), SqliteStore(db_path)):
+        await store.open()
+        await store.insert_message(StoredMessage(
+            id=11, properties_raw=b"\x01", body=b"blob-bytes",
+            exchange="ex", routing_key="rk", refer_count=1))
+        metas = await store.select_message_metas([11])
+        assert metas[11].body is None, type(store).__name__
+        assert metas[11].refer_count == 1
+        # and the stored row is untouched (stripping hit a copy)
+        full = await store.select_message(11)
+        assert full.body == b"blob-bytes", type(store).__name__
+        await store.close()
+
+
+async def test_memory_store_writes_apply_at_call_time():
+    """MemoryStore writes take effect at call time (program order == store
+    order, like SqliteStore._submit): a read issued with ZERO event-loop
+    yields after a fire-and-forget write must see it — the broker's paged
+    transient bodies depend on this (store_bg(insert) then an inline
+    basic_get read)."""
+    from chanamq_tpu.store.memory import MemoryStore
+
+    store = MemoryStore()
+    await store.open()
+    aw = store.insert_message(StoredMessage(
+        id=5, properties_raw=b"", body=b"x", exchange="e",
+        routing_key="r", refer_count=1))
+    # no await of the write yet — read anyway
+    got = await store.select_message(5)
+    assert got is not None and got.body == b"x"
+    await aw  # completed awaitable is still awaitable
+    del_aw = store.delete_message(5)
+    assert await store.select_message(5) is None
+    await del_aw
