@@ -260,6 +260,7 @@ def run_spec(name: str, rate: int = 0) -> dict:
         for child in children:
             if child.poll() is None:
                 child.kill()
+            child.communicate()  # reap: no zombies/leaked pipe fds
         errors.append(f"{type(exc).__name__}: {exc}")
     finally:
         broker.terminate()

@@ -375,6 +375,21 @@ class Queue:
         self._hydrate_task = asyncio.get_event_loop().create_task(
             self._hydrate_head())
 
+    def _prune_passivated(self) -> None:
+        """Drop settled entries (hydrated / dead / final-unreferred) off the
+        front of the passivated deque. basic_get hydrates bodies without
+        going through _collect_hydrate_targets — without this prune a
+        publish-burst → basic_get-drain cycle would retain every hydrated
+        body through the deque forever, invisible to resident_bytes."""
+        passivated = self._passivated
+        while passivated:
+            qm = passivated[0]
+            if (qm.dead or qm.message.refer_count <= 0
+                    or qm.message.body is not None):
+                passivated.popleft()
+            else:
+                break
+
     def _collect_hydrate_targets(self) -> list[QueuedMessage]:
         """Pop the next hydration batch off the passivated deque, lazily
         discarding entries already settled by other paths (hydrated via
@@ -474,6 +489,7 @@ class Queue:
         first (the reference Promise-latches Get on the lazy store load,
         MessageEntity.scala:82-102). The entry is CLAIMED (popped) before
         the store read so a concurrent dispatch pass can't starve the get."""
+        self._prune_passivated()
         while True:
             self._expire_head()
             if not self.messages:
@@ -497,6 +513,7 @@ class Queue:
                         msg.header_raw = sm.properties_raw
                     self.broker.account_memory(len(sm.body))
                     msg.accounted = True
+                self._prune_passivated()  # this entry is settled now
             self._advance_watermark(qm)
             return qm
 

@@ -35,6 +35,8 @@ class BrokerServer:
         frame_max: int = 131072,
         channel_max: int = 2047,
         store: Optional[StoreService] = None,
+        max_connections: int = 0,
+        backlog: int = 128,
     ) -> None:
         self.broker = broker or Broker(store=store)
         self.host = host
@@ -44,17 +46,24 @@ class BrokerServer:
         self.heartbeat_s = heartbeat_s
         self.frame_max = frame_max
         self.channel_max = channel_max
+        # listener resource limits (reference: ServerSettings
+        # max-connections / backlog, Settings.scala:141-219); 0 = uncapped
+        self.max_connections = max_connections
+        self.backlog = backlog
+        self.refused_connections = 0
         self._servers: list[asyncio.AbstractServer] = []
         self._connections: set[AMQPConnection] = set()
 
     async def start(self) -> None:
         await self.broker.start()
-        server = await asyncio.start_server(self._on_client, self.host, self.port)
+        server = await asyncio.start_server(
+            self._on_client, self.host, self.port, backlog=self.backlog)
         self._servers.append(server)
         log.info("AMQP listening on %s:%d", self.host, self.port)
         if self.tls_port is not None and self.ssl_context is not None:
             tls_server = await asyncio.start_server(
-                self._on_client, self.host, self.tls_port, ssl=self.ssl_context)
+                self._on_client, self.host, self.tls_port,
+                ssl=self.ssl_context, backlog=self.backlog)
             self._servers.append(tls_server)
             log.info("AMQPS listening on %s:%d", self.host, self.tls_port)
 
@@ -65,6 +74,22 @@ class BrokerServer:
     async def _on_client(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        if (self.max_connections
+                and len(self._connections) >= self.max_connections):
+            # refuse at accept: a TCP close before the protocol header is
+            # the one refusal every client library understands at this
+            # stage (Connection.Close can't be sent pre-Start). Existing
+            # connections are untouched.
+            self.refused_connections += 1
+            log.warning(
+                "refusing connection: %d live >= max-connections %d",
+                len(self._connections), self.max_connections)
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+            return
         connection = AMQPConnection(
             self.broker, reader, writer,
             heartbeat_s=self.heartbeat_s, frame_max=self.frame_max,
@@ -153,6 +178,8 @@ class BrokerServer:
             heartbeat_s=max(1, round(heartbeat)) if heartbeat else 0,
             frame_max=config.size_bytes("chana.mq.amqp.connection.frame-max"),
             channel_max=config.int("chana.mq.amqp.connection.channel-max"),
+            max_connections=config.int("chana.mq.server.max-connections") or 0,
+            backlog=config.int("chana.mq.server.backlog") or 128,
         )
 
 

@@ -42,6 +42,12 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.amqp.connection.heartbeat": "30s",
     "chana.mq.amqp.connection.frame-max": "128KiB",
     "chana.mq.amqp.connection.channel-max": 2047,
+    # listener resource limits (reference: ServerSettings max-connections /
+    # backlog, Settings.scala:141-219). Connections beyond max-connections
+    # are refused at accept time with a TCP close; existing traffic is
+    # unaffected. 0 disables the cap.
+    "chana.mq.server.max-connections": 1024,
+    "chana.mq.server.backlog": 128,
     "chana.mq.internal.timeout": "20s",
     "chana.mq.message.inactive": "1h",
     "chana.mq.message.sweep-interval": "1s",

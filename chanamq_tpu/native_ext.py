@@ -1,8 +1,10 @@
 """ctypes bindings for the native hot paths (native/chanamq_native.cpp).
 
-Loads native/libchanamq_native.so, compiling it on first use when a C++
-toolchain is present. Falls back silently (callers keep the pure-Python
-implementations) when the library can't be built or CHANAMQ_NATIVE=0.
+Load order: (1) the library pip built at install time
+(chanamq_tpu/_chanamq_native*.so, see setup.py), (2) a repo checkout's
+native/libchanamq_native.so, compiled on first use when a C++ toolchain is
+present. Falls back silently (callers keep the pure-Python implementations)
+when no library can be found or built, or CHANAMQ_NATIVE=0.
 
 Exposes:
   NativeFrameParser  — drop-in for amqp.frame.FrameParser
@@ -12,6 +14,7 @@ Exposes:
 from __future__ import annotations
 
 import ctypes
+import glob
 import logging
 import os
 import subprocess
@@ -43,6 +46,26 @@ def _build() -> bool:
         return False
 
 
+def _find_lib() -> Optional[str]:
+    src = os.path.join(_NATIVE_DIR, "chanamq_native.cpp")
+    # (1) library built by pip at install time, sitting inside the package —
+    # unless a repo checkout's source is newer (editable-install dev loop:
+    # a stale pip build must not shadow edited native code)
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    installed = sorted(glob.glob(os.path.join(pkg_dir, "_chanamq_native*.so")))
+    if installed and not (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(installed[0])):
+        return installed[0]
+    # (2) repo checkout: make-on-demand in native/
+    needs_build = not os.path.exists(_LIB_PATH) or (
+        os.path.exists(src)
+        and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH))
+    if needs_build and not _build():
+        return None
+    return _LIB_PATH
+
+
 def load() -> Optional[ctypes.CDLL]:
     """The shared library, building it on demand. None when unavailable."""
     global _lib, _load_attempted
@@ -53,14 +76,11 @@ def load() -> Optional[ctypes.CDLL]:
     _load_attempted = True
     if os.environ.get("CHANAMQ_NATIVE", "1") in ("0", "false", "no"):
         return None
-    src = os.path.join(_NATIVE_DIR, "chanamq_native.cpp")
-    needs_build = not os.path.exists(_LIB_PATH) or (
-        os.path.exists(src)
-        and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH))
-    if needs_build and not _build():
+    lib_path = _find_lib()
+    if lib_path is None:
         return None
     try:
-        lib = ctypes.CDLL(_LIB_PATH)
+        lib = ctypes.CDLL(lib_path)
     except OSError as exc:
         log.info("native lib load failed: %r", exc)
         return None
@@ -86,7 +106,7 @@ def load() -> Optional[ctypes.CDLL]:
     lib.chana_trie_size.restype = ctypes.c_int
     lib.chana_trie_size.argtypes = [ctypes.c_void_p]
     _lib = lib
-    log.info("native hot paths loaded from %s", _LIB_PATH)
+    log.info("native hot paths loaded from %s", lib_path)
     return _lib
 
 

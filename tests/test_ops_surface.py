@@ -194,3 +194,57 @@ async def test_admin_mutations_require_post(stack):
     status, _ = await http_req(admin.bound_port, "/admin/vhost/put/evil")
     assert status == 405
     assert "evil" not in server.broker.vhosts
+
+
+# ---------------------------------------------------------------------------
+# listener resource limits (reference: ServerSettings max-connections /
+# backlog, Settings.scala:141-219)
+# ---------------------------------------------------------------------------
+
+
+async def test_max_connections_refuses_excess_cleanly():
+    """Connections beyond chana.mq.server.max-connections are refused with
+    a TCP close before the handshake, while existing connections keep
+    working undisturbed."""
+    from chanamq_tpu.client import AMQPClient
+
+    server = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                          max_connections=2)
+    await server.start()
+    try:
+        c1 = await AMQPClient.connect("127.0.0.1", server.bound_port)
+        c2 = await AMQPClient.connect("127.0.0.1", server.bound_port)
+        # third connection: TCP accepted then closed pre-handshake
+        with pytest.raises((ConnectionError, asyncio.IncompleteReadError,
+                            EOFError, OSError)):
+            await AMQPClient.connect("127.0.0.1", server.bound_port)
+        assert server.refused_connections == 1
+        # existing connections unaffected: full declare/publish/get cycle
+        ch = await c1.channel()
+        await ch.queue_declare("lim_q")
+        ch.basic_publish(b"still-alive", routing_key="lim_q")
+        await c1.drain()
+        for _ in range(50):
+            msg = await ch.basic_get("lim_q", no_ack=True)
+            if msg is not None:
+                break
+            await asyncio.sleep(0.02)
+        assert msg is not None and bytes(msg.body) == b"still-alive"
+        await c2.close()
+        # a slot freed: new connections are admitted again
+        c3 = await AMQPClient.connect("127.0.0.1", server.bound_port)
+        await c3.close()
+        await c1.close()
+    finally:
+        await server.stop()
+
+
+def test_listener_limit_knobs_from_config():
+    from chanamq_tpu.config import Config
+
+    cfg = Config(overrides={"chana.mq.admin.enabled": False,
+                            "chana.mq.server.max-connections": 7,
+                            "chana.mq.server.backlog": 9})
+    server = BrokerServer.from_config(cfg)
+    assert server.max_connections == 7
+    assert server.backlog == 9

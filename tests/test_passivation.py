@@ -302,3 +302,30 @@ async def test_transient_paged_body_visible_to_inline_basic_get():
         assert got == [b"m%d" % i for i in range(6)]
     finally:
         await broker.stop()
+
+
+async def test_basic_get_drain_does_not_retain_hydrated_bodies():
+    """basic_get hydrates without the dispatch-path collector: the
+    passivated deque must still shed settled entries, or a publish-burst →
+    get-drain cycle retains every hydrated body forever (invisible to
+    resident_bytes)."""
+    from chanamq_tpu.store.memory import MemoryStore
+
+    broker = Broker(store=MemoryStore(), queue_max_resident=2)
+    await broker.start()
+    try:
+        await broker.declare_queue("/", "q", durable=False)
+        queue = broker.vhost("/").queues["q"]
+        for cycle in range(3):
+            for i in range(20):
+                await broker.publish(
+                    "/", "", "q", BasicProperties(delivery_mode=1), b"x" * 512)
+            while True:
+                qm = await queue.basic_get()
+                if qm is None:
+                    break
+                broker.unrefer(qm.message)
+            assert len(queue._passivated) == 0, (cycle, len(queue._passivated))
+        assert broker.resident_bytes == 0
+    finally:
+        await broker.stop()
