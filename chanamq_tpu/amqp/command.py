@@ -66,68 +66,72 @@ class CommandAssembler:
         # channel id -> in-flight (command, expected_body_size, received_size)
         self._partial: dict[int, _Partial] = {}
 
-    def feed(self, frame: Frame) -> Iterator["AMQCommand | FrameError"]:
+    def feed_one(self, frame: Frame) -> "AMQCommand | FrameError | None":
+        """Feed one frame; returns the completed command, a protocol error,
+        or None while content is still pending. The hot-loop shape (plain
+        call, no generator per frame): every frame produces at most one
+        result by construction."""
         channel = frame.channel
         partial = self._partial.get(channel)
         if frame.type == FrameType.METHOD:
             if partial is not None:
-                yield FrameError(
+                return FrameError(
                     ErrorCode.UNEXPECTED_FRAME,
                     f"method frame while content pending on channel {channel}",
                 )
-                return
             try:
                 method = decode_method(frame.payload)
             except MethodDecodeError as exc:
-                yield FrameError(ErrorCode.COMMAND_INVALID, str(exc))
-                return
+                return FrameError(ErrorCode.COMMAND_INVALID, str(exc))
             except Exception as exc:
-                yield FrameError(ErrorCode.SYNTAX_ERROR, f"bad method arguments: {exc}")
-                return
+                return FrameError(ErrorCode.SYNTAX_ERROR, f"bad method arguments: {exc}")
             if method.HAS_CONTENT:
                 self._partial[channel] = _Partial(AMQCommand(channel, method))
-            else:
-                yield AMQCommand(channel, method)
+                return None
+            return AMQCommand(channel, method)
+        elif frame.type == FrameType.BODY:
+            if partial is None or partial.expected_size is None:
+                return FrameError(
+                    ErrorCode.UNEXPECTED_FRAME,
+                    f"unexpected body frame on channel {channel}",
+                )
+            partial.chunks.append(frame.payload)
+            partial.received += len(frame.payload)
+            if partial.received > partial.expected_size:
+                del self._partial[channel]
+                return FrameError(
+                    ErrorCode.FRAME_ERROR,
+                    f"body overflows declared size on channel {channel}",
+                )
+            if partial.received == partial.expected_size:
+                partial.command.body = b"".join(partial.chunks)
+                del self._partial[channel]
+                return partial.command
+            return None
         elif frame.type == FrameType.HEADER:
             if partial is None or partial.expected_size is not None:
-                yield FrameError(
+                return FrameError(
                     ErrorCode.UNEXPECTED_FRAME,
                     f"unexpected header frame on channel {channel}",
                 )
-                return
             try:
                 _class_id, body_size, props = BasicProperties.decode_header(frame.payload)
             except Exception as exc:
-                yield FrameError(ErrorCode.SYNTAX_ERROR, f"bad content header: {exc}")
-                return
+                return FrameError(ErrorCode.SYNTAX_ERROR, f"bad content header: {exc}")
             partial.command.properties = props
             partial.command.header_raw = frame.payload
             partial.expected_size = body_size
             if body_size == 0:
                 del self._partial[channel]
-                yield partial.command
-        elif frame.type == FrameType.BODY:
-            if partial is None or partial.expected_size is None:
-                yield FrameError(
-                    ErrorCode.UNEXPECTED_FRAME,
-                    f"unexpected body frame on channel {channel}",
-                )
-                return
-            partial.chunks.append(frame.payload)
-            partial.received += len(frame.payload)
-            if partial.received > partial.expected_size:
-                del self._partial[channel]
-                yield FrameError(
-                    ErrorCode.FRAME_ERROR,
-                    f"body overflows declared size on channel {channel}",
-                )
-                return
-            if partial.received == partial.expected_size:
-                partial.command.body = b"".join(partial.chunks)
-                del self._partial[channel]
-                yield partial.command
+                return partial.command
+            return None
         else:
-            yield FrameError(ErrorCode.UNEXPECTED_FRAME, f"frame type {frame.type}")
+            return FrameError(ErrorCode.UNEXPECTED_FRAME, f"frame type {frame.type}")
+
+    def feed(self, frame: Frame) -> Iterator["AMQCommand | FrameError"]:
+        result = self.feed_one(frame)
+        if result is not None:
+            yield result
 
     def abort_channel(self, channel: int) -> None:
         """Drop any in-flight content on a channel (e.g. on channel close)."""

@@ -678,6 +678,48 @@ class Broker:
         enqueue block, so no foreign connection's ops can land inside even
         when the clustered path awaits remote pushes) — pass them to
         ``flush(intervals=...)`` for per-publisher failure attribution."""
+        if self.cluster is None:
+            return self.publish_sync(
+                vhost_name, exchange_name, routing_key, properties, body,
+                mandatory=mandatory, immediate=immediate,
+                header_raw=header_raw, marks=marks)
+        vhost, queue_names = self._publish_route(
+            vhost_name, exchange_name, routing_key, properties)
+        self.metrics.published(len(body))
+        return await self._publish_clustered(
+            vhost, exchange_name, routing_key, properties, body,
+            queue_names, mandatory=mandatory, immediate=immediate,
+            header_raw=header_raw, marks=marks)
+
+    def publish_sync(
+        self,
+        vhost_name: str,
+        exchange_name: str,
+        routing_key: str,
+        properties: BasicProperties,
+        body: bytes,
+        *,
+        mandatory: bool = False,
+        immediate: bool = False,
+        header_raw: Optional[bytes] = None,
+        marks: Optional[list[tuple[int, int]]] = None,
+    ) -> tuple[bool, bool]:
+        """publish() for the single-node case: identical semantics (the
+        local branch never awaits anything), as a plain call so the
+        per-message hot loop skips the coroutine machinery. Callers must
+        check ``broker.cluster is None`` first."""
+        assert self.cluster is None
+        vhost, queue_names = self._publish_route(
+            vhost_name, exchange_name, routing_key, properties)
+        self.metrics.published(len(body))
+        return self._publish_local(
+            vhost, queue_names, exchange_name, routing_key, properties,
+            body, immediate, header_raw, marks)
+
+    def _publish_route(
+        self, vhost_name: str, exchange_name: str, routing_key: str,
+        properties: BasicProperties,
+    ) -> tuple[VHost, set[str]]:
         vhost = self.vhost(vhost_name)
         exchange = vhost.exchanges.get(exchange_name)
         if exchange is None:
@@ -695,12 +737,20 @@ class Broker:
         else:
             queue_names = vhost.route(exchange_name, routing_key, properties.headers)
             assert queue_names is not None
-        self.metrics.published(len(body))
-        if self.cluster is not None:
-            return await self._publish_clustered(
-                vhost, exchange_name, routing_key, properties, body,
-                queue_names, mandatory=mandatory, immediate=immediate,
-                header_raw=header_raw, marks=marks)
+        return vhost, queue_names
+
+    def _publish_local(
+        self,
+        vhost: VHost,
+        queue_names: set[str],
+        exchange_name: str,
+        routing_key: str,
+        properties: BasicProperties,
+        body: bytes,
+        immediate: bool,
+        header_raw: Optional[bytes],
+        marks: Optional[list[tuple[int, int]]],
+    ) -> tuple[bool, bool]:
         queues = [vhost.queues[qn] for qn in queue_names if qn in vhost.queues]
         if not queues:
             return (False, True)

@@ -277,13 +277,6 @@ class Queue:
             self._advance_watermark(qm)
             self.broker.unrefer(qm.message)
 
-    def pop(self) -> Optional[QueuedMessage]:
-        """Pop the next live message (skipping+dropping expired/dead heads).
-        Callers must ensure the head is hydrated first (body is not None)."""
-        self._expire_head()
-        if not self.messages:
-            return None
-        return self.messages.popleft()
 
     def _advance_watermark(self, qm: QueuedMessage) -> None:
         if qm.offset > self.last_consumed:
@@ -328,27 +321,25 @@ class Queue:
         if self.deleted:
             return
         new_unacks: list[tuple[int, int, int, Optional[int]]] = []
-        while self.messages and self.consumers:
+        messages = self.messages
+        while messages and self.consumers:
+            # one expire pass per iteration; head checks and the pop below
+            # all act on the same entry, so no re-validation is needed
             self._expire_head()
-            if not self.messages:
+            if not messages:
                 break
-            if self.messages[0].message.body is None:
+            qm = messages[0]
+            if qm.message.body is None:
                 # head is passivated: reattach bodies from the store first;
                 # dispatch resumes when the hydration pass completes
                 # (reference: MessageEntity.Get lazy store load,
                 # MessageEntity.scala:82-102)
                 self._start_hydration()
                 break
-            consumer = self._next_eligible_consumer()
+            consumer = self._next_eligible_consumer(qm.body_size)
             if consumer is None:
                 break
-            qm = self.pop()
-            if qm is None:
-                break
-            if qm.message.body is None:  # head changed under the checks above
-                self.messages.appendleft(qm)
-                self._start_hydration()
-                break
+            messages.popleft()
             delivery = consumer.deliver(self, qm)
             self._advance_watermark(qm)
             if delivery is None:  # no_ack: consumed immediately
@@ -468,19 +459,16 @@ class Queue:
         else:
             self.schedule_dispatch()
 
-    def _next_eligible_consumer(self) -> Optional["Consumer"]:
+    def _next_eligible_consumer(self, size: int) -> Optional["Consumer"]:
+        """Round-robin pick of a consumer with prefetch budget for a
+        `size`-byte delivery (reference fair poll: AMQChannel.scala:43-48)."""
         n = len(self.consumers)
         for i in range(n):
             consumer = self.consumers[(self._rr_index + i) % n]
-            if consumer.can_take(self._head_size()):
+            if consumer.can_take(size):
                 self._rr_index = (self._rr_index + i + 1) % n
                 return consumer
         return None
-
-    def _head_size(self) -> int:
-        # body_size, not len(body): the head may be passivated (body None)
-        self._expire_head()
-        return self.messages[0].body_size if self.messages else 0
 
     # -- get (polling read) ------------------------------------------------
 

@@ -57,16 +57,65 @@ class ConnectionClosedError(AMQPClientError):
         self.reply_text = reply_text
 
 
-@dataclass(slots=True)
 class DeliveredMessage:
-    consumer_tag: str
-    delivery_tag: int
-    redelivered: bool
-    exchange: str
-    routing_key: str
-    properties: BasicProperties
-    body: bytes
-    message_count: Optional[int] = None  # set for basic.get replies
+    """One delivered (or got) message. `properties` decodes lazily from the
+    raw content-header payload: the consume hot loop never pays the full
+    BasicProperties parse for callbacks that only read the body."""
+
+    __slots__ = ("consumer_tag", "delivery_tag", "redelivered", "exchange",
+                 "routing_key", "body", "message_count",
+                 "_properties", "_header_raw")
+
+    def __init__(
+        self, consumer_tag: str, delivery_tag: int, redelivered: bool,
+        exchange: str, routing_key: str, body: bytes,
+        properties: Optional[BasicProperties] = None,
+        header_raw: Optional[bytes] = None,
+        message_count: Optional[int] = None,  # set for basic.get replies
+    ) -> None:
+        self.consumer_tag = consumer_tag
+        self.delivery_tag = delivery_tag
+        self.redelivered = redelivered
+        self.exchange = exchange
+        self.routing_key = routing_key
+        self.body = body
+        self.message_count = message_count
+        self._properties = properties
+        self._header_raw = header_raw
+
+    @property
+    def properties(self) -> BasicProperties:
+        if self._properties is None:
+            if self._header_raw is not None:
+                _, _, self._properties = BasicProperties.decode_header(
+                    self._header_raw)
+            else:
+                self._properties = BasicProperties()
+        return self._properties
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, DeliveredMessage):
+            return NotImplemented
+        return (
+            self.consumer_tag == other.consumer_tag
+            and self.delivery_tag == other.delivery_tag
+            and self.redelivered == other.redelivered
+            and self.exchange == other.exchange
+            and self.routing_key == other.routing_key
+            and self.properties == other.properties
+            and self.body == other.body
+            and self.message_count == other.message_count
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"DeliveredMessage(consumer_tag={self.consumer_tag!r}, "
+            f"delivery_tag={self.delivery_tag}, "
+            f"redelivered={self.redelivered}, exchange={self.exchange!r}, "
+            f"routing_key={self.routing_key!r}, "
+            f"properties={self.properties!r}, body={self.body!r}, "
+            f"message_count={self.message_count})"
+        )
 
 
 @dataclass(slots=True)
@@ -258,7 +307,7 @@ class AMQPClient:
         fast_partial: dict[int, list] = {}
         try:
             while True:
-                data = await self.reader.read(65536)
+                data = await self.reader.read(262144)
                 if not data:
                     await self._shutdown(ConnectionClosedError(0, "server closed"))
                     return
@@ -294,8 +343,16 @@ class AMQPClient:
                     elif cid in fast_partial:
                         partial = fast_partial[cid]
                         if ftype == FrameType.HEADER:
-                            _, body_size, props = BasicProperties.decode_header(payload)
-                            partial[1] = props
+                            # raw header only: properties decode lazily on
+                            # DeliveredMessage.properties access (hot loop:
+                            # class 2B + weight 2B, then 8B body size)
+                            if len(payload) < 12:
+                                await self._shutdown(ConnectionClosedError(
+                                    502,
+                                    f"truncated content header on channel {cid}"))
+                                return
+                            body_size = int.from_bytes(payload[4:12], "big")
+                            partial[1] = payload
                             partial[2] = body_size
                             if body_size == 0:
                                 del fast_partial[cid]
@@ -331,7 +388,7 @@ class AMQPClient:
         msg = DeliveredMessage(
             consumer_tag=consumer_tag, delivery_tag=delivery_tag,
             redelivered=redelivered, exchange=exchange,
-            routing_key=routing_key, properties=partial[1], body=body,
+            routing_key=routing_key, header_raw=partial[1], body=body,
         )
         callback = channel._consumers.get(consumer_tag)
         if callback is not None:
