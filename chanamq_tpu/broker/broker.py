@@ -315,6 +315,8 @@ class Broker:
             if name in vhost.queues:
                 return vhost.queues[name]
             vhost.queues[name] = queue
+            if self.cluster is not None:
+                self.cluster.claim_queue(queue)
             return queue
         if self.cluster is not None:
             meta = self.cluster.queue_metas.get((vhost_name, name))
@@ -329,6 +331,7 @@ class Broker:
                     arguments=dict(meta.get("arguments") or {}),
                 )
                 vhost.queues[name] = queue
+                self.cluster.claim_queue(queue)
                 return queue
         return None
 
@@ -487,6 +490,7 @@ class Broker:
                 "kind": "queue.declared", "vhost": vhost_name, "name": name,
                 "durable": durable, "auto_delete": auto_delete,
                 "ttl_ms": ttl_ms, "arguments": arguments,
+                "holder": self.cluster.name,
             })
         return queue
 

@@ -102,7 +102,7 @@ class ClusterNode:
                 continue
         # deactivate local queues this node does not own (boot recovery
         # loaded everything; sharded ownership says otherwise)
-        self._deactivate_unowned()
+        self._deactivate_unowned(boot=True)
         # lease a snowflake worker id from the leader (reference:
         # ServiceBoard blocking on AskNodeId, ServiceBoard.scala:40-48 —
         # but bounded and non-blocking here)
@@ -127,6 +127,19 @@ class ClusterNode:
     # ------------------------------------------------------------------
 
     def queue_owner(self, vhost: str, name: str) -> str:
+        """Where ops on this queue must go. A live HOLDER (the node actually
+        serving the queue, replicated through queue metas) wins over the
+        hash ring: on a ring reshuffle (node join) the old owner keeps
+        serving a queue with live consumers/messages — routing to the new
+        ring owner would activate a second copy from the shared store and
+        deliver duplicates. The ring decides only when no live holder
+        exists (fresh queue, holder died, or holder released when idle)."""
+        meta = self.queue_metas.get((vhost, name))
+        if meta is not None:
+            holder = meta.get("holder")
+            if holder and (holder == self.name
+                           or self.membership.is_alive(holder)):
+                return holder
         owner = self.ring.owner_entity("q", vhost, name)
         return owner or self.name
 
@@ -147,32 +160,114 @@ class ClusterNode:
             return False
         return not self.owns_queue(vhost, name)
 
-    def _deactivate_unowned(self) -> None:
+    def _deactivate_unowned(self, boot: bool = False) -> None:
         for vhost in self.broker.vhosts.values():
             for name in list(vhost.queues):
                 queue = vhost.queues[name]
                 if queue.exclusive_owner is not None:
                     continue
+                meta = self.queue_metas.get((vhost.name, name))
+                other = meta.get("holder") if meta else None
+                # at boot, membership is still converging: a named foreign
+                # holder must be deferred to even before it gossips alive,
+                # or a joiner that pre-recovered the shared store claims a
+                # queue another node is actively serving
+                foreign = bool(other and other != self.name
+                               and (boot or self.membership.is_alive(other)))
+                if foreign:
+                    if boot and not queue.consumers and not queue.outstanding:
+                        # we just booted and loaded this queue from the
+                        # shared store while another node is (per the
+                        # snapshot) actively serving it: our copy only
+                        # duplicates its durable contents (transients never
+                        # recover), so drop it — a second copy would
+                        # deliver duplicates. If that holder is in fact
+                        # dead, its down event clears the holdership and
+                        # the ring owner reactivates from the store.
+                        # Release the RAM gauge but do NOT unrefer: the
+                        # store rows belong to the holder.
+                        for qm in queue.messages:
+                            msg = qm.message
+                            if msg.accounted:
+                                self.broker.account_memory(
+                                    -len(msg.body or b""))
+                                msg.accounted = False
+                        queue.deleted = True
+                        del vhost.queues[name]
+                        continue
+                    if queue.consumers or queue.messages or queue.outstanding:
+                        # dual-holder conflict at steady state (a claim
+                        # race): resolve DETERMINISTICALLY — the
+                        # lexicographically smaller name wins — so the two
+                        # sides can't flip holdership back and forth with
+                        # racing broadcasts. The loser keeps draining its
+                        # copy to its already-attached local consumers but
+                        # stops being a routing target for new ops.
+                        if self.name < other:
+                            log.warning(
+                                "%s: reclaiming %s/%s from dual holder %s",
+                                self.name, vhost.name, name, other)
+                            self._register_meta(queue)
+                            self._set_holder(vhost.name, name, self.name)
+                        else:
+                            log.warning(
+                                "%s: deferring %s/%s to dual holder %s",
+                                self.name, vhost.name, name, other)
+                        continue
+                    # idle local shell under a live foreign holder
+                    queue.deleted = True
+                    del vhost.queues[name]
+                    continue
+                # no live foreign holder. Evaluate placement BEFORE any
+                # claim so an idle shell hands off with at most one
+                # broadcast instead of a claim-then-release pair.
+                live = bool(queue.consumers or queue.messages
+                            or queue.outstanding)
+                ring_owned = (
+                    self.ring.owner_entity("q", vhost.name, name) == self.name)
+                if not ring_owned and not live:
+                    # idle shell owned elsewhere by the ring: hand off
+                    queue.deleted = True
+                    del vhost.queues[name]
+                    if other is not None:
+                        self._set_holder(vhost.name, name, None)
+                    continue
+                # we keep serving (ring owner, or sticky live copy — a ring
+                # reshuffle on join moves nothing mid-flight); broadcast the
+                # claim only when the replicated view doesn't already say so
                 self._register_meta(queue)
-                if self.owns_queue(vhost.name, name):
-                    continue
-                if queue.consumers or queue.messages or queue.outstanding:
-                    # Sticky: a queue with live local consumers/messages keeps
-                    # serving them; only idle shells hand off eagerly. Lazy
-                    # rebalance on join — new ops route to the ring owner
-                    # (known v1 limitation, akin to sharding without an
-                    # explicit handoff coordinator).
-                    continue
-                queue.deleted = True
-                del vhost.queues[name]
+                if other != self.name:
+                    self._set_holder(vhost.name, name, self.name)
 
     def _register_meta(self, queue: "Queue") -> None:
+        # registering a live local queue claims holdership: ops for it must
+        # come to this node while it serves consumers/messages
         self.queue_metas[(queue.vhost, queue.name)] = {
             "durable": queue.durable,
             "auto_delete": queue.auto_delete,
             "ttl_ms": queue.ttl_ms,
             "arguments": dict(queue.arguments or {}),
+            "holder": self.name,
         }
+
+    def _set_holder(self, vhost: str, name: str, holder: Optional[str]) -> None:
+        """Record + replicate who serves a queue (None = released: the
+        hash ring decides again)."""
+        meta = self.queue_metas.get((vhost, name))
+        if meta is not None:
+            meta["holder"] = holder
+        self.broadcast_bg("meta.apply", {
+            "kind": "queue.holder", "vhost": vhost, "name": name,
+            "holder": holder,
+        })
+
+    def claim_queue(self, queue: "Queue") -> None:
+        """Called by the broker when a queue materializes locally
+        (declare/activate): this node becomes the holder cluster-wide."""
+        if queue.exclusive_owner is not None:
+            return
+        self._register_meta(queue)
+        self._set_holder(queue.vhost, queue.name, self.name)
 
     # ------------------------------------------------------------------
     # membership reactions
@@ -181,6 +276,13 @@ class ClusterNode:
     def _on_membership_event(self, event: str, member: Member) -> None:
         assert self.membership is not None
         self.ring.set_nodes(self.membership.alive_members())
+        if event == "down":
+            # a dead node can't serve anything: clear its holderships so
+            # queue_owner falls back to the ring (node names embed ephemeral
+            # ports, so a stale holder entry would otherwise pin forever)
+            for meta in self.queue_metas.values():
+                if meta.get("holder") == member.name:
+                    meta["holder"] = None
         self._deactivate_unowned()
         # re-register remote consumers whose queues changed owner; also
         # requeue outstanding deliveries from consumers whose origin died
@@ -384,7 +486,13 @@ class ClusterNode:
                 "auto_delete": bool(payload.get("auto_delete")),
                 "ttl_ms": payload.get("ttl_ms"),
                 "arguments": payload.get("arguments") or {},
+                "holder": payload.get("holder"),
             }
+            return {}
+        if kind == "queue.holder":
+            meta = self.queue_metas.get((vhost_name, str(payload["name"])))
+            if meta is not None:
+                meta["holder"] = payload.get("holder")
             return {}
         if kind == "queue.deleted":
             name = str(payload["name"])
