@@ -106,7 +106,7 @@ async def test_confirms_flushed_before_pipelined_channel_close(client):
     close_fut = asyncio.get_event_loop().create_task(ch.close())
     await asyncio.wait_for(close_fut, 5)
     # every publish was confirmed before the channel went away
-    assert ch.unconfirmed == set()
+    assert not ch.unconfirmed
 
 
 async def test_wait_unconfirmed_wakes_on_close(server):
