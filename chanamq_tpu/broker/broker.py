@@ -669,6 +669,7 @@ class Broker:
         immediate: bool = False,
         header_raw: Optional[bytes] = None,
         marks: Optional[list[tuple[int, int]]] = None,
+        exrk_raw: Optional[bytes] = None,
     ) -> tuple[bool, bool]:
         """Route one message. Returns (routed, deliverable):
         routed=False    -> mandatory handling applies,
@@ -686,7 +687,7 @@ class Broker:
             return self.publish_sync(
                 vhost_name, exchange_name, routing_key, properties, body,
                 mandatory=mandatory, immediate=immediate,
-                header_raw=header_raw, marks=marks)
+                header_raw=header_raw, marks=marks, exrk_raw=exrk_raw)
         vhost, queue_names = self._publish_route(
             vhost_name, exchange_name, routing_key, properties)
         self.metrics.published(len(body))
@@ -707,6 +708,7 @@ class Broker:
         immediate: bool = False,
         header_raw: Optional[bytes] = None,
         marks: Optional[list[tuple[int, int]]] = None,
+        exrk_raw: Optional[bytes] = None,
     ) -> tuple[bool, bool]:
         """publish() for the single-node case: identical semantics (the
         local branch never awaits anything), as a plain call so the
@@ -718,7 +720,7 @@ class Broker:
         self.metrics.published(len(body))
         return self._publish_local(
             vhost, queue_names, exchange_name, routing_key, properties,
-            body, immediate, header_raw, marks)
+            body, immediate, header_raw, marks, exrk_raw)
 
     def _publish_route(
         self, vhost_name: str, exchange_name: str, routing_key: str,
@@ -754,6 +756,7 @@ class Broker:
         immediate: bool,
         header_raw: Optional[bytes],
         marks: Optional[list[tuple[int, int]]],
+        exrk_raw: Optional[bytes] = None,
     ) -> tuple[bool, bool]:
         queues = [vhost.queues[qn] for qn in queue_names if qn in vhost.queues]
         if not queues:
@@ -764,7 +767,7 @@ class Broker:
             return (True, False)
         self.push_local(
             queues, properties, body, exchange_name, routing_key,
-            header_raw, marks)
+            header_raw, marks, exrk_raw)
         return (True, True)
 
     def push_local(
@@ -776,6 +779,7 @@ class Broker:
         routing_key: str,
         header_raw: Optional[bytes],
         marks: Optional[list[tuple[int, int]]],
+        exrk_raw: Optional[bytes] = None,
     ) -> Message:
         """The one local persistent-enqueue block, shared by the single-node
         publish, the clustered publish, and the cluster push handler: build
@@ -790,6 +794,7 @@ class Broker:
             self.idgen.next_id(), properties, body, exchange_name, routing_key,
             properties.expiration_ms(), header_raw=header_raw,
         )
+        message.exrk_raw = exrk_raw
         message.refer_count = len(queues)
         self.account_message(message)
         persist = message.is_persistent and any(q.durable for q in queues)

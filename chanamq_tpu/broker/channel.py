@@ -180,14 +180,19 @@ class ServerChannel:
     def _render_deliver(
         self, consumer: Consumer, tag: int, redelivered: bool, msg, body: bytes
     ) -> bytes:
-        ex = msg.exchange.encode("utf-8")
-        rk = msg.routing_key.encode("utf-8")
+        # length-prefixed exchange+routing-key: captured verbatim from the
+        # publish frame when possible, else built once and cached
+        exrk = msg.exrk_raw
+        if exrk is None:
+            ex = msg.exchange.encode("utf-8")
+            rk = msg.routing_key.encode("utf-8")
+            exrk = msg.exrk_raw = (
+                bytes((len(ex),)) + ex + bytes((len(rk),)) + rk)
         method_payload = b"".join((
             consumer._deliver_prefix,
             tag.to_bytes(8, "big"),
             b"\x01" if redelivered else b"\x00",
-            bytes((len(ex),)), ex,
-            bytes((len(rk),)), rk,
+            exrk,
         ))
         header_payload = msg.header_payload()
         cid = self.id

@@ -862,6 +862,7 @@ class AMQPConnection:
             mandatory=method.mandatory, immediate=method.immediate,
             header_raw=command.header_raw,
             marks=self._confirm_marks if seq is not None else None,
+            exrk_raw=method._values.get("exrk_raw"),
         )
         self._publish_aftermath(channel, command, props, routed, deliverable, seq)
         return True
@@ -876,6 +877,7 @@ class AMQPConnection:
             mandatory=method.mandatory, immediate=method.immediate,
             header_raw=command.header_raw,
             marks=self._confirm_marks if seq is not None else None,
+            exrk_raw=method._values.get("exrk_raw"),
         )
         self._publish_aftermath(channel, command, props, routed, deliverable, seq)
 

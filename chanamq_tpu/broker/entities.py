@@ -52,7 +52,7 @@ class Message:
     __slots__ = (
         "id", "properties", "body", "exchange", "routing_key",
         "ttl_ms", "refer_count", "persisted", "published_ns", "header_raw",
-        "accounted", "paged",
+        "accounted", "paged", "exrk_raw",
     )
 
     def __init__(
@@ -85,6 +85,10 @@ class Message:
         # persisted blob, but never promised durable: no queue-log/unack
         # rows are written for it and recovery never resurrects it
         self.paged = False
+        # length-prefixed exchange + routing-key wire slice (as basic.deliver
+        # frames need it); captured from the publish frame when available,
+        # else built lazily by the first deliver render
+        self.exrk_raw: Optional[bytes] = None
 
     def header_payload(self) -> bytes:
         hp = self.header_raw
@@ -271,11 +275,19 @@ class Queue:
     def _expire_head(self) -> None:
         """Drop expired and dead (blob gone from the store) head entries."""
         now = now_ms()
+        expired = False
         while self.messages and (
                 self.messages[0].dead or self.messages[0].is_expired(now)):
             qm = self.messages.popleft()
             self._advance_watermark(qm)
             self.broker.unrefer(qm.message)
+            expired = True
+        if expired and self._passivated:
+            # settled (expired) entries must leave the passivated deque too:
+            # on a consumerless TTL'd queue nothing else ever prunes it, and
+            # each retained entry pins a Message (properties + header_raw)
+            # invisibly to the resident_bytes gauge
+            self._prune_passivated()
 
 
     def _advance_watermark(self, qm: QueuedMessage) -> None:

@@ -81,6 +81,7 @@ class BrokerServer:
             # stage (Connection.Close can't be sent pre-Start). Existing
             # connections are untouched.
             self.refused_connections += 1
+            self.broker.metrics.connections_refused += 1
             log.warning(
                 "refusing connection: %d live >= max-connections %d",
                 len(self._connections), self.max_connections)

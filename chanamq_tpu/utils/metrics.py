@@ -65,6 +65,8 @@ class Metrics:
         self.expired_msgs = 0
         self.connections_opened = 0
         self.connections_closed = 0
+        # accepts refused at the listener cap (chana.mq.server.max-connections)
+        self.connections_refused = 0
         self.publish_to_deliver_us = Histogram()
         self.started_at = time.time()
 
@@ -90,6 +92,7 @@ class Metrics:
             "expired_msgs": self.expired_msgs,
             "connections_opened": self.connections_opened,
             "connections_closed": self.connections_closed,
+            "connections_refused": self.connections_refused,
             "publish_to_deliver_p50_us": h.percentile_us(0.50),
             "publish_to_deliver_p99_us": h.percentile_us(0.99),
             "publish_to_deliver_mean_us": h.mean_us,

@@ -329,3 +329,29 @@ async def test_basic_get_drain_does_not_retain_hydrated_bodies():
         assert broker.resident_bytes == 0
     finally:
         await broker.stop()
+
+
+async def test_expired_passivated_entries_leave_the_deque():
+    """A consumerless TTL'd queue: expiry must prune the passivated deque
+    too, or each burst pins dead Message objects (properties + header_raw)
+    forever, invisible to resident_bytes."""
+    from chanamq_tpu.store.memory import MemoryStore
+
+    broker = Broker(store=MemoryStore(), queue_max_resident=2,
+                    message_sweep_interval_s=0)
+    await broker.start()
+    try:
+        await broker.declare_queue("/", "q", durable=False,
+                                   arguments={"x-message-ttl": 30})
+        queue = broker.vhost("/").queues["q"]
+        for i in range(20):
+            await broker.publish(
+                "/", "", "q", BasicProperties(delivery_mode=1), b"x" * 256)
+        assert len(queue._passivated) > 0
+        await asyncio.sleep(0.1)  # everything expires
+        queue._expire_head()
+        assert len(queue.messages) == 0
+        assert len(queue._passivated) == 0
+        assert broker.resident_bytes == 0
+    finally:
+        await broker.stop()
