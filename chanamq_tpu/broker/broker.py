@@ -44,6 +44,10 @@ class BrokerError(Exception):
 class Broker:
     """All broker state for one node."""
 
+    # recovery loads queue metadata in chunks of this many rows so a deep
+    # durable backlog never materializes all metas in RAM at once
+    RECOVER_META_CHUNK = 4096
+
     def __init__(
         self,
         store: Optional[StoreService] = None,
@@ -253,34 +257,39 @@ class Broker:
         from .entities import QueuedMessage
 
         # recovery honors the passivation watermark: metadata (props header,
-        # routing, refcount) loads for every entry in one batch, but bodies
-        # load only for the resident head — a deep durable backlog must not
-        # reload every blob into RAM (nor even read it: select_message_metas
+        # routing, refcount) loads CHUNKED so the transient meta dict never
+        # double-holds the whole backlog alongside the inflated messages
+        # (the reference streams per-entity, selectQueue on activation) —
+        # and bodies load only for the resident head (select_message_metas
         # skips the body column)
-        ids = [msg_id for (_, msg_id, _, _) in entries]
-        metas = await self.store.select_message_metas(ids)
         limit = self.queue_max_resident or len(entries)
-        resident_ids = [m for (_, m, _, _) in entries[:limit] if m in metas]
-        bodies = await self.store.select_messages(resident_ids)
+        resident_ids = set(m for (_, m, _, _) in entries[:limit])
         max_offset = sq.last_consumed
-        for offset, msg_id, size, expire_at in entries:
-            meta = metas.get(msg_id)
-            if meta is None:
-                continue
-            message = self._inflate(meta)
-            message.refer_count = meta.refer_count
-            message.persisted = True
-            full = bodies.get(msg_id)
-            message.body = full.body if full is not None else None
-            if full is not None:
-                self.account_message(message)
-            qm = QueuedMessage(message, offset, expire_at, body_size=size)
-            queue.messages.append(qm)
-            if message.body is None:
-                # deep-tail entry recovered without its blob: register it
-                # for batch hydration just like a live passivation would
-                queue._passivated.append(qm)
-            max_offset = max(max_offset, offset)
+        for start in range(0, len(entries), self.RECOVER_META_CHUNK):
+            chunk = entries[start:start + self.RECOVER_META_CHUNK]
+            metas = await self.store.select_message_metas(
+                [msg_id for (_, msg_id, _, _) in chunk])
+            bodies = await self.store.select_messages(
+                [m for (_, m, _, _) in chunk
+                 if m in resident_ids and m in metas])
+            for offset, msg_id, size, expire_at in chunk:
+                meta = metas.get(msg_id)
+                if meta is None:
+                    continue
+                message = self._inflate(meta)
+                message.refer_count = meta.refer_count
+                message.persisted = True
+                full = bodies.get(msg_id)
+                message.body = full.body if full is not None else None
+                if full is not None:
+                    self.account_message(message)
+                qm = QueuedMessage(message, offset, expire_at, body_size=size)
+                queue.messages.append(qm)
+                if message.body is None:
+                    # deep-tail entry recovered without its blob: register
+                    # it for batch hydration like a live passivation would
+                    queue._passivated.append(qm)
+                max_offset = max(max_offset, offset)
         queue.next_offset = max_offset + 1
         if sq.unacks:
             # Recovered unacks re-enter the queue as ready messages. They
