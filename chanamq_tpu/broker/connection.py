@@ -61,6 +61,10 @@ LOCALES = b"en_US"
 WRITE_HIGH_WATERMARK = 4 * 1024 * 1024
 WRITE_LOW_WATERMARK = 1 * 1024 * 1024
 
+# method-frame payload prefix of Basic.Publish (class 60, method 40): the
+# scan hot loop recognizes publishes before any decode
+_PUBLISH_SIG = b"\x00\x3c\x00\x28"
+
 
 class ConnectionClosed(Exception):
     pass
@@ -148,6 +152,9 @@ class AMQPConnection:
         # client announced capabilities.connection.blocked in start-ok:
         # it wants Connection.Blocked/Unblocked notifications
         self._supports_blocked = False
+        # frames the current _fused_publish covered (so _consume_scan's
+        # soft-error handlers resume past the failed publish's frames)
+        self._fused_skip = 0
 
     # ------------------------------------------------------------------
     # output path
@@ -257,6 +264,10 @@ class AMQPConnection:
         ))
 
     async def _main_loop(self) -> None:
+        # the native parser exposes the raw scan arrays: the hot loop walks
+        # them directly (fused publish path); the pure-Python parser keeps
+        # the Frame-object path
+        scan = getattr(self._parser, "scan_batches", None)
         while not self.closing:
             # inbound backpressure: above the memory high watermark, pure
             # publishers stop being read (their bytes back up into TCP)
@@ -276,41 +287,195 @@ class AMQPConnection:
             if self.closing:
                 return
             data = await self._read_chunk()
-            for item in self._parser.feed(data):
-                if isinstance(item, FrameError):
-                    await self._hard_close(item.code, item.message)
+            if scan is not None:
+                if not await self._consume_scan(scan(data)):
                     return
-                if item.type == FrameType.HEARTBEAT:
-                    continue  # _last_recv already updated
-                out = self._assembler.feed_one(item)
-                if out is None:
-                    continue  # content still assembling
-                if isinstance(out, FrameError):
-                    await self._hard_close(out.code, out.message)
-                    return
-                try:
-                    if not self._try_fast_publish(out):
-                        await self._dispatch(out)
-                except HardError as exc:
-                    await self._hard_close(
-                        exc.code, exc.text, exc.class_id, exc.method_id)
-                    return
-                except ChannelError as exc:
-                    await self._soft_close_channel(out.channel, exc)
-                except BrokerError as exc:
-                    if exc.code.is_hard_error:
-                        await self._hard_close(
-                            exc.code, exc.text,
-                            out.method.CLASS_ID, out.method.METHOD_ID)
-                        return
-                    await self._soft_close_channel(
-                        out.channel,
-                        ChannelError(exc.code, exc.text,
-                                     out.method.CLASS_ID, out.method.METHOD_ID))
-                if self.closing:
+            else:
+                if not await self._consume_feed(self._parser.feed(data)):
                     return
             await self._confirm_barrier()
             self._flush_confirms()
+
+    async def _run_command(self, out: AMQCommand) -> bool:
+        """Dispatch one assembled command with the connection's error
+        semantics. Returns False when the connection must stop serving."""
+        try:
+            if not self._try_fast_publish(out):
+                await self._dispatch(out)
+        except HardError as exc:
+            await self._hard_close(
+                exc.code, exc.text, exc.class_id, exc.method_id)
+            return False
+        except ChannelError as exc:
+            await self._soft_close_channel(out.channel, exc)
+        except BrokerError as exc:
+            if exc.code.is_hard_error:
+                await self._hard_close(
+                    exc.code, exc.text,
+                    out.method.CLASS_ID, out.method.METHOD_ID)
+                return False
+            await self._soft_close_channel(
+                out.channel,
+                ChannelError(exc.code, exc.text,
+                             out.method.CLASS_ID, out.method.METHOD_ID))
+        return not self.closing
+
+    async def _consume_feed(self, items) -> bool:
+        for item in items:
+            if isinstance(item, FrameError):
+                await self._hard_close(item.code, item.message)
+                return False
+            if item.type == FrameType.HEARTBEAT:
+                continue  # _last_recv already updated
+            out = self._assembler.feed_one(item)
+            if out is None:
+                continue  # content still assembling
+            if isinstance(out, FrameError):
+                await self._hard_close(out.code, out.message)
+                return False
+            if not await self._run_command(out):
+                return False
+        return True
+
+    async def _consume_scan(self, batches) -> bool:
+        """The native-parser read loop: walk the scan arrays directly. A
+        contained Basic.Publish (method+header+body in one batch, plain
+        flags) short-circuits through _fused_publish without constructing
+        Frame / Method / AMQCommand objects; everything else falls back to
+        the Frame path one frame at a time."""
+        partials = self._assembler._partial
+        for batch in batches:
+            if isinstance(batch, FrameError):
+                await self._hard_close(batch.code, batch.message)
+                return False
+            raw, n, types, channels, offsets, lengths = batch
+            i = 0
+            while i < n:
+                ftype = types[i]
+                if ftype == 8:  # heartbeat: _last_recv already updated
+                    i += 1
+                    continue
+                channel_id = channels[i]
+                off = offsets[i]
+                if (ftype == 1 and self._fast_path
+                        and channel_id not in partials
+                        and raw[off:off + 4] == _PUBLISH_SIG
+                        and i + 1 < n and types[i + 1] == 2
+                        and channels[i + 1] == channel_id):
+                    try:
+                        consumed = self._fused_publish(
+                            raw, i, n, types, channels, offsets, lengths)
+                    except HardError as exc:
+                        await self._hard_close(
+                            exc.code, exc.text, exc.class_id, exc.method_id)
+                        return False
+                    except ChannelError as exc:
+                        await self._soft_close_channel(channel_id, exc)
+                        if self.closing:  # flipped during the await
+                            return False
+                        i += self._fused_skip
+                        continue
+                    except BrokerError as exc:
+                        if exc.code.is_hard_error:
+                            await self._hard_close(exc.code, exc.text, 60, 40)
+                            return False
+                        await self._soft_close_channel(
+                            channel_id,
+                            ChannelError(exc.code, exc.text, 60, 40))
+                        if self.closing:  # flipped during the await
+                            return False
+                        i += self._fused_skip
+                        continue
+                    if consumed:
+                        i += consumed
+                        continue
+                frame = Frame(ftype, channel_id, raw[off:off + lengths[i]])
+                i += 1
+                out = self._assembler.feed_one(frame)
+                if out is None:
+                    continue
+                if isinstance(out, FrameError):
+                    await self._hard_close(out.code, out.message)
+                    return False
+                if not await self._run_command(out):
+                    return False
+        return True
+
+    @property
+    def _fast_path(self) -> bool:
+        return (self._opened and self.broker.cluster is None
+                and not self._closing_channels)
+
+    def _fused_publish(
+        self, raw, i, n, types, channels, offsets, lengths
+    ) -> int:
+        """Publish straight off the scan arrays: returns the number of
+        frames consumed (method + header + body frames), or 0 to fall back
+        to the generic Frame/assembler path (rare shapes: mandatory or
+        immediate bits, body spanning into the next read, interleaved
+        channels, unknown channel). Semantics mirror _try_fast_publish —
+        same publish_sync call, same confirm arming — minus the Return
+        cases, which the bit check routes to the fallback."""
+        moff = offsets[i]
+        payload = raw[moff:moff + lengths[i]]
+        try:
+            exchange, routing_key, bits, pos = am.parse_publish_wire(payload)
+        except (IndexError, UnicodeDecodeError, am.MethodDecodeError):
+            return 0  # truncated/bad payload: generic path raises properly
+        if bits:
+            return 0  # mandatory / immediate: generic path renders Returns
+        exrk_raw = payload[6:pos]
+        channel = self.channels.get(channels[i])
+        if channel is None:
+            return 0  # full path raises the proper channel error
+        hoff = offsets[i + 1]
+        header = raw[hoff:hoff + lengths[i + 1]]
+        body_size = int.from_bytes(header[4:12], "big")
+        channel_id = channels[i]
+        consumed = 2
+        if body_size == 0:
+            body = b""
+        else:
+            j = i + 2
+            got = 0
+            first = None
+            chunks = None
+            while got < body_size:
+                if j >= n or types[j] != 3 or channels[j] != channel_id:
+                    return 0  # spans the batch / interleaved: generic path
+                boff = offsets[j]
+                blen = lengths[j]
+                got += blen
+                if got > body_size:
+                    return 0  # overflow: generic path raises FRAME_ERROR
+                if first is None:
+                    first = raw[boff:boff + blen]
+                else:
+                    if chunks is None:
+                        chunks = [first]
+                    chunks.append(raw[boff:boff + blen])
+                j += 1
+            body = first if chunks is None else b"".join(chunks)
+            consumed = j - i
+        try:
+            _class_id, _size, props = BasicProperties.decode_header(header)
+        except Exception:
+            return 0  # generic path raises the proper SYNTAX_ERROR
+        # count the skip before publish: the except handlers in
+        # _consume_scan resume past this publish's frames on soft errors
+        self._fused_skip = consumed
+        seq = self._arm_confirm(channel)
+        self.broker.publish_sync(
+            self.vhost_name, exchange, routing_key, props, body,
+            header_raw=header,
+            marks=self._confirm_marks if seq is not None else None,
+            exrk_raw=exrk_raw,
+        )
+        if seq is not None:
+            # coalesce: one Basic.Ack(multiple=true) per read batch
+            self._pending_confirms[channel_id] = seq
+            self.broker.metrics.confirmed_msgs += 1
+        return consumed
 
     async def _confirm_barrier(self) -> None:
         """Durability barrier before releasing publisher confirms: a confirm

@@ -138,11 +138,17 @@ class NativeFrameParser:
         self._consumed = ctypes.c_int64()
         self._error = ctypes.c_int32()
 
-    def feed(self, data: bytes) -> Iterator[Frame | FrameError]:
+    def scan_batches(self, data: bytes) -> Iterator[tuple | FrameError]:
+        """Scan a read chunk into frame-index batches WITHOUT creating Frame
+        objects: yields ``(raw, n, types, channels, offsets, lengths)``
+        tuples (the arrays are reused between yields — consume a batch fully
+        before advancing), then a FrameError if the stream is corrupt. The
+        connection hot loop walks the arrays directly; feed() adapts them to
+        Frame objects for everything else."""
         if self._dead:
             return
-        # One buffer->bytes conversion per feed() call (NOT per scan pass —
-        # a per-pass copy would be O(n^2) when a backlog accumulates); the
+        # One buffer->bytes conversion per call (NOT per scan pass — a
+        # per-pass copy would be O(n^2) when a backlog accumulates); the
         # rare >_MAX_FRAMES_PER_SCAN continuation slices off the consumed
         # prefix, amortized O(1) per byte.
         if self._buf:
@@ -157,11 +163,9 @@ class NativeFrameParser:
                 self._types, self._channels, self._offsets, self._lengths,
                 _MAX_FRAMES_PER_SCAN, ctypes.byref(self._consumed),
                 ctypes.byref(self._error))
-            for i in range(n):
-                off = self._offsets[i]
-                yield Frame(
-                    self._types[i], self._channels[i],
-                    raw[off : off + self._lengths[i]])
+            if n:
+                yield (raw, n, self._types, self._channels,
+                       self._offsets, self._lengths)
             consumed = self._consumed.value
             error = self._error.value
             if error:
@@ -182,6 +186,16 @@ class NativeFrameParser:
                     self._buf = bytearray(raw[consumed:])
                 return
             raw = raw[consumed:]
+
+    def feed(self, data: bytes) -> Iterator[Frame | FrameError]:
+        for batch in self.scan_batches(data):
+            if isinstance(batch, FrameError):
+                yield batch
+                return
+            raw, n, types, channels, offsets, lengths = batch
+            for i in range(n):
+                off = offsets[i]
+                yield Frame(types[i], channels[i], raw[off:off + lengths[i]])
 
 
 class NativeTopicMatcher(Matcher):

@@ -9,6 +9,7 @@ percentiles, with negligible hot-path cost.
 
 from __future__ import annotations
 
+from bisect import bisect_left
 import time
 from typing import Optional
 
@@ -29,13 +30,11 @@ class Histogram:
         self.total_us = 0
 
     def observe_us(self, us: float) -> None:
+        # once per delivered message: bisect, not a linear bound walk (at
+        # saturated latencies the walk visited most of the 22 bounds)
         self.count += 1
         self.total_us += int(us)
-        for i, bound in enumerate(self.BOUNDS):
-            if us <= bound:
-                self.buckets[i] += 1
-                return
-        self.buckets[-1] += 1
+        self.buckets[bisect_left(self.BOUNDS, us)] += 1
 
     def percentile_us(self, p: float) -> Optional[float]:
         """Upper-bound estimate of the p-quantile (p in [0,1])."""
