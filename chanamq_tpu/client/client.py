@@ -306,80 +306,156 @@ class AMQPClient:
         # fast-path state for in-flight basic.deliver content, per channel:
         # [fields_tuple, props, body_size, chunks, received]
         fast_partial: dict[int, list] = {}
+        scan = getattr(self._parser, "scan_batches", None)
         try:
             while True:
                 data = await self.reader.read(262144)
                 if not data:
                     await self._shutdown(ConnectionClosedError(0, "server closed"))
                     return
-                for item in self._parser.feed(data):
-                    if isinstance(item, FrameError):
-                        await self._shutdown(
-                            ConnectionClosedError(int(item.code), item.message))
+                if scan is not None:
+                    if not await self._consume_scan(scan(data), fast_partial):
                         return
-                    ftype = item.type
-                    cid = item.channel
-                    payload = item.payload
-                    # -- basic.deliver fast path: per AMQP 0-9-1 §4.2.6
-                    # content frames are never interleaved with other frames
-                    # on the SAME channel, so a tiny inline state machine can
-                    # own the method->header->body sequence and skip the
-                    # generic assembler + Method object entirely.
-                    if ftype == FrameType.METHOD:
-                        if cid in fast_partial:
-                            # §4.2.6: content frames are never interleaved
-                            # with methods on the same channel. Feeding the
-                            # assembler with fast state still active would
-                            # silently desynchronize delivery, so fail loud.
-                            del fast_partial[cid]
-                            await self._shutdown(ConnectionClosedError(
-                                505,
-                                "method frame interleaved with in-flight "
-                                f"content on channel {cid}"))
-                            return
-                        if payload[:4] == b"\x00\x3c\x00\x3c":
-                            fast_partial[cid] = [
-                                _parse_deliver_fields(payload), None, 0, [], 0]
-                            continue
-                    elif cid in fast_partial:
-                        partial = fast_partial[cid]
-                        if ftype == FrameType.HEADER:
-                            # raw header only: properties decode lazily on
-                            # DeliveredMessage.properties access (hot loop:
-                            # class 2B + weight 2B, then 8B body size)
-                            if len(payload) < 12:
-                                await self._shutdown(ConnectionClosedError(
-                                    502,
-                                    f"truncated content header on channel {cid}"))
-                                return
-                            body_size = int.from_bytes(payload[4:12], "big")
-                            partial[1] = payload
-                            partial[2] = body_size
-                            if body_size == 0:
-                                del fast_partial[cid]
-                                await self._deliver_fast(cid, partial, b"")
-                            continue
-                        if ftype == FrameType.BODY:
-                            partial[3].append(payload)
-                            partial[4] += len(payload)
-                            if partial[4] >= partial[2]:
-                                del fast_partial[cid]
-                                chunks = partial[3]
-                                body = chunks[0] if len(chunks) == 1 else b"".join(chunks)
-                                await self._deliver_fast(cid, partial, body)
-                            continue
-                    if ftype == FrameType.HEARTBEAT:
-                        continue
-                    for out in self._assembler.feed(item):
-                        if isinstance(out, FrameError):
+                else:
+                    for item in self._parser.feed(data):
+                        if isinstance(item, FrameError):
                             await self._shutdown(
-                                ConnectionClosedError(int(out.code), out.message))
+                                ConnectionClosedError(int(item.code), item.message))
                             return
-                        await self._on_command(out)
+                        if not await self._handle_frame(
+                                item.type, item.channel, item.payload,
+                                fast_partial):
+                            return
         except asyncio.CancelledError:
             pass
         except Exception as exc:
             await self._shutdown(exc)
+
+    async def _consume_scan(self, batches, fast_partial: dict) -> bool:
+        """Native-parser read loop: walk the scan arrays directly. A
+        contained basic.deliver (method+header+body frames in one batch)
+        is handled inline with no Frame objects at all; everything else
+        (cross-batch content, other methods) drops to _handle_frame."""
+        for batch in batches:
+            if isinstance(batch, FrameError):
+                await self._shutdown(
+                    ConnectionClosedError(int(batch.code), batch.message))
+                return False
+            raw, n, types, channels, offsets, lengths = batch
+            i = 0
+            while i < n:
+                ftype = types[i]
+                if ftype == 8:  # heartbeat
+                    i += 1
+                    continue
+                cid = channels[i]
+                off = offsets[i]
+                if (ftype == 1 and cid not in fast_partial
+                        and raw[off:off + 4] == b"\x00\x3c\x00\x3c"
+                        and i + 1 < n and types[i + 1] == 2
+                        and channels[i + 1] == cid):
+                    hoff = offsets[i + 1]
+                    header = raw[hoff:hoff + lengths[i + 1]]
+                    if len(header) >= 12:
+                        body_size = int.from_bytes(header[4:12], "big")
+                        j = i + 2
+                        got = 0
+                        first = None
+                        chunks = None
+                        complete = body_size == 0
+                        while got < body_size:
+                            if j >= n or types[j] != 3 or channels[j] != cid:
+                                break  # spans the batch: stateful path
+                            boff = offsets[j]
+                            blen = lengths[j]
+                            got += blen
+                            if first is None:
+                                first = raw[boff:boff + blen]
+                            else:
+                                if chunks is None:
+                                    chunks = [first]
+                                chunks.append(raw[boff:boff + blen])
+                            j += 1
+                            if got >= body_size:
+                                complete = True
+                        if complete:
+                            if body_size == 0:
+                                body = b""
+                            else:
+                                body = first if chunks is None else b"".join(chunks)
+                            fields = _parse_deliver_fields(
+                                raw[off:off + lengths[i]])
+                            await self._deliver_fast(cid, (fields, header), body)
+                            i = max(j, i + 2)
+                            continue
+                if not await self._handle_frame(
+                        ftype, cid, raw[off:off + lengths[i]], fast_partial):
+                    return False
+                i += 1
+        return True
+
+    async def _handle_frame(
+        self, ftype: int, cid: int, payload: bytes, fast_partial: dict
+    ) -> bool:
+        """One frame through the stateful path: the per-channel deliver
+        state machine first, then the generic assembler. Returns False when
+        the connection has shut down."""
+        # -- basic.deliver fast path: per AMQP 0-9-1 §4.2.6 content frames
+        # are never interleaved with other frames on the SAME channel, so a
+        # tiny inline state machine can own the method->header->body
+        # sequence and skip the generic assembler + Method object entirely.
+        if ftype == FrameType.METHOD:
+            if cid in fast_partial:
+                # §4.2.6: content frames are never interleaved with methods
+                # on the same channel. Feeding the assembler with fast state
+                # still active would silently desynchronize delivery.
+                del fast_partial[cid]
+                await self._shutdown(ConnectionClosedError(
+                    505,
+                    "method frame interleaved with in-flight "
+                    f"content on channel {cid}"))
+                return False
+            if payload[:4] == b"\x00\x3c\x00\x3c":
+                fast_partial[cid] = [
+                    _parse_deliver_fields(payload), None, 0, [], 0]
+                return True
+        elif cid in fast_partial:
+            partial = fast_partial[cid]
+            if ftype == FrameType.HEADER:
+                # raw header only: properties decode lazily on
+                # DeliveredMessage.properties access (hot loop: class 2B +
+                # weight 2B, then 8B body size)
+                if len(payload) < 12:
+                    await self._shutdown(ConnectionClosedError(
+                        502, f"truncated content header on channel {cid}"))
+                    return False
+                body_size = int.from_bytes(payload[4:12], "big")
+                partial[1] = payload
+                partial[2] = body_size
+                if body_size == 0:
+                    del fast_partial[cid]
+                    await self._deliver_fast(cid, partial, b"")
+                return True
+            if ftype == FrameType.BODY:
+                partial[3].append(payload)
+                partial[4] += len(payload)
+                if partial[4] >= partial[2]:
+                    del fast_partial[cid]
+                    chunks = partial[3]
+                    body = chunks[0] if len(chunks) == 1 else b"".join(chunks)
+                    await self._deliver_fast(cid, partial, body)
+                return True
+        if ftype == FrameType.HEARTBEAT:
+            return True
+        out = self._assembler.feed_one(
+            Frame(ftype, cid, payload))
+        if out is not None:
+            if isinstance(out, FrameError):
+                await self._shutdown(
+                    ConnectionClosedError(int(out.code), out.message))
+                return False
+            await self._on_command(out)
+        return True
 
     async def _deliver_fast(self, cid: int, partial: list, body: bytes) -> None:
         consumer_tag, delivery_tag, redelivered, exchange, routing_key = partial[0]
@@ -717,6 +793,11 @@ class ClientChannel:
         (exchange, routing-key, flags, properties object) — republishing
         with the same arguments only re-frames the header (body size varies)
         and the body."""
+        if type(body) is not bytes:
+            # snapshot mutable buffers (bytearray/memoryview) NOW: the body
+            # rides the write buffer by reference until the next loop-tick
+            # flush, and a caller-side mutation must not reach the wire
+            body = bytes(body)
         key = (exchange, routing_key, mandatory, immediate, id(properties))
         entry = self._publish_cache.get(key)
         if entry is not None and properties is not None \
@@ -734,32 +815,56 @@ class ClientChannel:
             props.write_properties(props_out)
             if len(self._publish_cache) >= 256:
                 self._publish_cache.clear()
+            # entry[4]: body-length -> fully-rendered wire prefix (method
+            # frame + header frame + body frame header) — a steady stream
+            # of same-shaped publishes is a dict hit + 3 buffer appends
             entry = (properties, props.copy(), method_frame,
-                     props_out.getvalue())
+                     props_out.getvalue(), {})
             self._publish_cache[key] = entry
-        method_frame, props_payload = entry[2], entry[3]
-        header_payload_len = 12 + len(props_payload)
-        cid = self.id
-        parts = [
-            method_frame,
-            _FRAME_HDR(2, cid, header_payload_len),
-            b"\x00\x3c\x00\x00",  # class 60 (basic), weight 0
-            len(body).to_bytes(8, "big"),
-            props_payload,
-            b"\xce",
-        ]
-        if body:
-            frame_max = self.client.frame_max
-            max_payload = (frame_max - FRAME_OVERHEAD) if frame_max else len(body)
-            if len(body) <= max_payload:
-                parts += (_FRAME_HDR(3, cid, len(body)), body, b"\xce")
-            else:
-                for off in range(0, len(body), max_payload):
-                    chunk = body[off:off + max_payload]
-                    parts += (_FRAME_HDR(3, cid, len(chunk)), chunk, b"\xce")
         if self.closed:
             raise self.close_reason or ChannelClosedError(0, "closed")
-        self.client._write(b"".join(parts))
+        body_len = len(body)
+        size_cache = entry[4]
+        prefix = size_cache.get(body_len)
+        if prefix is None:
+            method_frame, props_payload = entry[2], entry[3]
+            cid = self.id
+            frame_max = self.client.frame_max
+            max_payload = (frame_max - FRAME_OVERHEAD) if frame_max else body_len
+            header = (
+                _FRAME_HDR(2, cid, 12 + len(props_payload))
+                + b"\x00\x3c\x00\x00"  # class 60 (basic), weight 0
+                + body_len.to_bytes(8, "big")
+                + props_payload + b"\xce")
+            if body_len == 0 or body_len <= max_payload:
+                prefix = method_frame + header
+                if body_len:
+                    prefix += _FRAME_HDR(3, cid, body_len)
+                if len(size_cache) >= 64:
+                    size_cache.clear()
+                size_cache[body_len] = prefix
+            else:
+                # oversized body: fragment without caching (size varies by
+                # chunk; the cost is dominated by the copies anyway)
+                parts = [method_frame, header]
+                for off in range(0, body_len, max_payload):
+                    chunk = body[off:off + max_payload]
+                    parts += (_FRAME_HDR(3, cid, len(chunk)), chunk, b"\xce")
+                self.client._write(b"".join(parts))
+                if self.confirm_mode:
+                    self._publish_seq += 1
+                    self.unconfirmed.append(self._publish_seq)
+                    return self._publish_seq
+                return None
+        client = self.client
+        wparts = client._wparts
+        if body_len:
+            wparts += (prefix, body, b"\xce")
+        else:
+            wparts.append(prefix)
+        if not client._wflush_scheduled:
+            client._wflush_scheduled = True
+            asyncio.get_event_loop().call_soon(client._flush_writes)
         if self.confirm_mode:
             self._publish_seq += 1
             self.unconfirmed.append(self._publish_seq)
