@@ -93,7 +93,6 @@ class Broker:
         # the reference never implemented, README.md:10-22)
         self.blocked_listeners: set[Any] = set()
         self._sweep_task: Optional[asyncio.Task] = None
-        self._bg_tasks: set[asyncio.Task] = set()
         self._msg_delete_buf: list[int] = []
         self._started = False
 
@@ -190,8 +189,7 @@ class Broker:
         if paged_ids:
             self.store_bg(self.store.delete_messages(list(paged_ids)))
         # let queued background store writes drain before closing
-        if self._bg_tasks:
-            await asyncio.gather(*self._bg_tasks, return_exceptions=True)
+        await self.store.drain_nowait()
         await self.store.close()
         self._started = False
 
@@ -199,15 +197,9 @@ class Broker:
         """Fire-and-forget store write. Both built-in backends apply ops
         synchronously at call time (SQLite enqueues into its group-commit
         queue, MemoryStore mutates eagerly), so program order == store
-        order; this wrapper only tracks completion and logs failures."""
-        task = asyncio.ensure_future(aw)  # type: ignore[arg-type]
-        self._bg_tasks.add(task)
-        task.add_done_callback(self._bg_done)
-
-    def _bg_done(self, task: "asyncio.Future") -> None:
-        self._bg_tasks.discard(task)
-        if not task.cancelled() and task.exception():
-            log.error("background store write failed: %r", task.exception())
+        order; the store's shared tracker keeps the task alive, logs
+        failures, and drains at stop()."""
+        self.store._fire(aw)
 
     # -- recovery (reference: stash-until-Loaded preStart reloads,
     #    QueueEntity.scala:107-135, ExchangeEntity.scala:137-174) ----------
@@ -809,12 +801,12 @@ class Broker:
         persist = message.is_persistent and any(q.durable for q in queues)
         if persist:
             message.persisted = True
-            self.store_bg(self.store.insert_message(StoredMessage(
+            self.store.insert_message_nowait(StoredMessage(
                 id=message.id,
                 properties_raw=message.header_payload(),
                 body=body, exchange=exchange_name, routing_key=routing_key,
                 refer_count=len(queues), ttl_ms=message.ttl_ms,
-            )))
+            ))
         body_size = len(body)
         for queue in queues:
             queue.push(message, body_size=body_size)

@@ -1125,9 +1125,9 @@ class AMQPConnection:
             if queue.durable and msg.persisted:
                 # mirror the consume dispatch path: the unacked message must
                 # survive a restart
-                self.broker.store_bg(self.broker.store.insert_queue_unacks(
+                self.broker.store.insert_queue_unacks_nowait(
                     queue.vhost, queue.name,
-                    [(msg.id, qm.offset, qm.body_size, qm.expire_at_ms)]))
+                    [(msg.id, qm.offset, qm.body_size, qm.expire_at_ms)])
 
     async def _on_get_remote(self, channel: ServerChannel, method: am.Basic.Get) -> None:
         """basic.get on a remotely-owned queue: fetch one message over RPC

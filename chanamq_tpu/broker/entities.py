@@ -231,11 +231,9 @@ class Queue:
         self.next_offset += 1
         self.messages.append(qm)
         if self.durable and message.persisted:
-            self.broker.store_bg(
-                self.broker.store.insert_queue_msg(
-                    self.vhost, self.name, qm.offset, message.id,
-                    qm.body_size, qm.expire_at_ms,
-                )
+            self.broker.store.insert_queue_msg_nowait(
+                self.vhost, self.name, qm.offset, message.id,
+                qm.body_size, qm.expire_at_ms,
             )
         # deep-backlog passivation (reference: MessageEntity pages ANY
         # inactive body out — transient included — persisting it first,
@@ -251,7 +249,7 @@ class Queue:
                 and message.body is not None):
             if not (message.persisted or message.paged):
                 message.paged = True
-                self.broker.store_bg(self.broker.store.insert_message(
+                self.broker.store.insert_message_nowait(
                     StoredMessage(
                         id=message.id,
                         properties_raw=message.header_payload(),
@@ -259,7 +257,7 @@ class Queue:
                         routing_key=message.routing_key,
                         refer_count=message.refer_count,
                         ttl_ms=message.ttl_ms,
-                    )))
+                    ))
             if message.accounted:
                 self.broker.account_memory(-len(message.body))
                 message.accounted = False
@@ -363,9 +361,8 @@ class Queue:
                         (qm.message.id, qm.offset, qm.body_size, qm.expire_at_ms)
                     )
         if new_unacks:
-            self.broker.store_bg(
-                self.broker.store.insert_queue_unacks(self.vhost, self.name, new_unacks)
-            )
+            self.broker.store.insert_queue_unacks_nowait(
+                self.vhost, self.name, new_unacks)
 
     # -- passivation / hydration -------------------------------------------
 

@@ -13,14 +13,19 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import logging
 from dataclasses import dataclass, field
 from typing import Any, Optional
+
+log = logging.getLogger("chanamq.store")
 
 
 def _done_future() -> "asyncio.Future[None]":
     fut: asyncio.Future = asyncio.get_event_loop().create_future()
     fut.set_result(None)
     return fut
+
+
 
 
 @dataclass(slots=True)
@@ -89,6 +94,55 @@ class StoreService:
         enqueue sequencing return 0 (callers then pass empty/degenerate
         intervals and flush() behaves as a plain barrier)."""
         return 0
+
+    # -- fire-and-forget fast paths ----------------------------------------
+    # The per-message hot ops (message blob, queue-log row, unack rows) are
+    # written fire-and-forget: callers need program-order enqueueing and
+    # barrier coverage, not a per-op completion handle. Backends override
+    # these to skip the future machinery (SqliteStore enqueues a bare
+    # callable; MemoryStore applies eagerly); the defaults wrap the async
+    # variant in a logged task so any backend is correct out of the box.
+
+    def _fire(self, aw) -> None:
+        """Track a fire-and-forget store write: kept alive in a per-store
+        set (an un-referenced task may be GC'd before running), failures
+        logged, drained by drain_nowait() at shutdown. This is THE
+        fire-and-forget tracker — Broker.store_bg routes here too."""
+        tasks = getattr(self, "_fired_tasks", None)
+        if tasks is None:
+            tasks = self._fired_tasks = set()
+        task = asyncio.ensure_future(aw)
+        tasks.add(task)
+        task.add_done_callback(self._fire_done)
+
+    def _fire_done(self, task) -> None:
+        self._fired_tasks.discard(task)
+        if not task.cancelled() and task.exception():
+            log.error("background store write failed: %r", task.exception())
+
+    async def drain_nowait(self) -> None:
+        """Let tracked fire-and-forget writes land — call before close().
+        (Backends overriding every *_nowait op may have nothing here; the
+        built-ins apply/enqueue at call time and flush in close().)"""
+        tasks = getattr(self, "_fired_tasks", None)
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    def insert_message_nowait(self, msg: StoredMessage) -> None:
+        self._fire(self.insert_message(msg))
+
+    def insert_queue_msg_nowait(
+        self, vhost: str, queue: str, offset: int, msg_id: int,
+        body_size: int, expire_at_ms: Optional[int],
+    ) -> None:
+        self._fire(self.insert_queue_msg(
+            vhost, queue, offset, msg_id, body_size, expire_at_ms))
+
+    def insert_queue_unacks_nowait(
+        self, vhost: str, queue: str,
+        unacks: list[tuple[int, int, int, Optional[int]]],
+    ) -> None:
+        self._fire(self.insert_queue_unacks(vhost, queue, unacks))
 
     # -- messages (refcounted blobs; reference: insertMessage/selectMessage/
     #    deleteMessage + referMessage/unreferMessage) ----------------------

@@ -133,6 +133,19 @@ class MemoryStore(StoreService):
                 q.unacks.pop(msg_id, None)
         return _DONE
 
+    # -- fire-and-forget fast paths: writes already apply at call time, so
+    #    the nowait variants just drop the _DONE handle -------------------
+
+    def insert_message_nowait(self, msg: StoredMessage) -> None:
+        self.insert_message(msg)
+
+    def insert_queue_msg_nowait(
+            self, vhost, queue, offset, msg_id, body_size, expire_at_ms) -> None:
+        self.insert_queue_msg(vhost, queue, offset, msg_id, body_size, expire_at_ms)
+
+    def insert_queue_unacks_nowait(self, vhost, queue, unacks) -> None:
+        self.insert_queue_unacks(vhost, queue, unacks)
+
     # -- delete/archive ----------------------------------------------------
 
     def archive_queue(self, vhost, queue):

@@ -31,11 +31,14 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import sqlite3
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional, TypeVar
 
 from .api import StoredExchange, StoredMessage, StoredQueue, StoreService
+
+log = logging.getLogger("chanamq.store")
 
 T = TypeVar("T")
 
@@ -136,6 +139,20 @@ class SqliteStore(StoreService):
             loop.call_soon(self._kick)
         return fut
 
+    def _submit_nowait(self, fn: Callable[[sqlite3.Connection], Any],
+                       guard: bool = False) -> None:
+        """Enqueue a fire-and-forget op: same FIFO queue and sequence
+        numbering as _submit (so durability-barrier attribution covers it),
+        but no future/callback machinery — the per-message hot path
+        (message blob + queue-log inserts) pays only a lambda and a list
+        append. Failures are logged and recorded for barriers."""
+        self._op_seq += 1
+        self._pending.append((fn, None, guard, self._op_seq))
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            loop = self._loop or asyncio.get_running_loop()
+            loop.call_soon(self._kick)
+
     def _kick(self) -> None:
         self._flush_scheduled = False
         self._maybe_dispatch_batch()
@@ -205,6 +222,10 @@ class SqliteStore(StoreService):
                     # report failure conservatively
                     self._failed_floor = max(
                         self._failed_floor, self._failed_seqs.pop(0))
+            if fut is None:  # _submit_nowait op
+                if exc is not None:
+                    log.error("background store write failed: %r", exc)
+                continue
             if fut.cancelled():
                 continue
             if exc is not None:
@@ -320,12 +341,19 @@ class SqliteStore(StoreService):
 
     # -- messages ---------------------------------------------------------
 
-    def insert_message(self, msg: StoredMessage):
-        return self._submit(lambda db: db.execute(
+    @staticmethod
+    def _insert_message_op(msg: StoredMessage):
+        return lambda db: db.execute(
             "INSERT OR REPLACE INTO msgs VALUES (?,?,?,?,?,?,?)",
             (msg.id, msg.properties_raw, msg.body, msg.exchange,
              msg.routing_key, msg.refer_count, msg.ttl_ms),
-        ), guard=False)
+        )
+
+    def insert_message(self, msg: StoredMessage):
+        return self._submit(self._insert_message_op(msg), guard=False)
+
+    def insert_message_nowait(self, msg: StoredMessage) -> None:
+        self._submit_nowait(self._insert_message_op(msg))
 
     @staticmethod
     def _row_to_message(row) -> StoredMessage:
@@ -435,11 +463,21 @@ class SqliteStore(StoreService):
 
     # -- queue log --------------------------------------------------------
 
-    def insert_queue_msg(self, vhost, queue, offset, msg_id, body_size, expire_at_ms):
-        return self._submit(lambda db: db.execute(
+    @staticmethod
+    def _insert_queue_msg_op(vhost, queue, offset, msg_id, body_size, expire_at_ms):
+        return lambda db: db.execute(
             "INSERT OR REPLACE INTO queue_msgs VALUES (?,?,?,?,?,?)",
             (vhost, queue, offset, msg_id, body_size, expire_at_ms),
-        ), guard=False)
+        )
+
+    def insert_queue_msg(self, vhost, queue, offset, msg_id, body_size, expire_at_ms):
+        return self._submit(self._insert_queue_msg_op(
+            vhost, queue, offset, msg_id, body_size, expire_at_ms), guard=False)
+
+    def insert_queue_msg_nowait(
+            self, vhost, queue, offset, msg_id, body_size, expire_at_ms) -> None:
+        self._submit_nowait(self._insert_queue_msg_op(
+            vhost, queue, offset, msg_id, body_size, expire_at_ms))
 
     def delete_queue_msg(self, vhost, queue, offset):
         return self._submit(lambda db: db.execute(
@@ -459,10 +497,18 @@ class SqliteStore(StoreService):
 
         return self._submit(w)
 
-    def insert_queue_unacks(self, vhost, queue, unacks):
-        return self._submit(lambda db: db.executemany(
+    @staticmethod
+    def _insert_queue_unacks_op(vhost, queue, unacks):
+        return lambda db: db.executemany(
             "INSERT OR REPLACE INTO queue_unacks VALUES (?,?,?,?,?,?)",
-            [(vhost, queue, m, o, s, e) for (m, o, s, e) in unacks]), guard=False)
+            [(vhost, queue, m, o, s, e) for (m, o, s, e) in unacks])
+
+    def insert_queue_unacks(self, vhost, queue, unacks):
+        return self._submit(
+            self._insert_queue_unacks_op(vhost, queue, unacks), guard=False)
+
+    def insert_queue_unacks_nowait(self, vhost, queue, unacks) -> None:
+        self._submit_nowait(self._insert_queue_unacks_op(vhost, queue, unacks))
 
     def delete_queue_unacks(self, vhost, queue, msg_ids):
         return self._submit(lambda db: db.executemany(

@@ -379,16 +379,16 @@ async def test_flush_attribution_two_confirm_publishers(db_path):
     under B's (the round-3 consume-once scar)."""
     srv = await start_server(db_path)
     store = srv.broker.store
-    orig_insert = store.insert_message
+    orig_insert = store.insert_message_nowait
 
     def failing_insert(msg):
         if msg.routing_key == "qb":
-            return store._submit(
-                lambda db: db.execute("INSERT INTO no_such_table VALUES (1)"),
-                guard=False)
-        return orig_insert(msg)
+            store._submit_nowait(
+                lambda db: db.execute("INSERT INTO no_such_table VALUES (1)"))
+            return
+        orig_insert(msg)
 
-    store.insert_message = failing_insert
+    store.insert_message_nowait = failing_insert
     a = await AMQPClient.connect("127.0.0.1", srv.bound_port)
     b = await AMQPClient.connect("127.0.0.1", srv.bound_port)
     cha = await a.channel()
@@ -411,7 +411,7 @@ async def test_flush_attribution_two_confirm_publishers(db_path):
     assert len(chb.unconfirmed) == 1  # the publish was never confirmed
 
     # A's message really is durable
-    store.insert_message = orig_insert
+    store.insert_message_nowait = orig_insert
     await a.close()
     await b.close()
     await srv.stop()
