@@ -61,9 +61,10 @@ LOCALES = b"en_US"
 WRITE_HIGH_WATERMARK = 4 * 1024 * 1024
 WRITE_LOW_WATERMARK = 1 * 1024 * 1024
 
-# method-frame payload prefix of Basic.Publish (class 60, method 40): the
-# scan hot loop recognizes publishes before any decode
+# method-frame payload prefixes the scan hot loop recognizes before any
+# decode: Basic.Publish (class 60, method 40) and Basic.Ack (60, 80)
 _PUBLISH_SIG = b"\x00\x3c\x00\x28"
+_ACK_SIG = b"\x00\x3c\x00\x50"
 
 
 class ConnectionClosed(Exception):
@@ -358,13 +359,17 @@ class AMQPConnection:
                 channel_id = channels[i]
                 off = offsets[i]
                 if (ftype == 1 and self._fast_path
-                        and channel_id not in partials
-                        and raw[off:off + 4] == _PUBLISH_SIG
-                        and i + 1 < n and types[i + 1] == 2
-                        and channels[i + 1] == channel_id):
+                        and channel_id not in partials):
+                    consumed = 0
                     try:
-                        consumed = self._fused_publish(
-                            raw, i, n, types, channels, offsets, lengths)
+                        sig = raw[off:off + 4]
+                        if (sig == _PUBLISH_SIG and i + 1 < n
+                                and types[i + 1] == 2
+                                and channels[i + 1] == channel_id):
+                            consumed = self._fused_publish(
+                                raw, i, n, types, channels, offsets, lengths)
+                        elif sig == _ACK_SIG and lengths[i] == 13:
+                            consumed = self._fused_ack(raw, off, channel_id)
                     except HardError as exc:
                         await self._hard_close(
                             exc.code, exc.text, exc.class_id, exc.method_id)
@@ -955,16 +960,41 @@ class AMQPConnection:
         range whose deliveries are already settled is a legal no-op.
         multiple overrides method.multiple for methods without the field
         (Reject)."""
+        AMQPConnection._check_settled_raw(
+            channel, deliveries, method.delivery_tag,
+            method.multiple if multiple is None else multiple,
+            method.CLASS_ID, method.METHOD_ID)
+
+    @staticmethod
+    def _check_settled_raw(
+        channel: ServerChannel, deliveries: list, tag: int, multiple: bool,
+        class_id: int, method_id: int,
+    ) -> None:
         if deliveries:
             return
-        tag = method.delivery_tag
-        if multiple is None:
-            multiple = method.multiple
         if not multiple or (tag != 0 and not channel.tag_was_issued(tag)):
             raise ChannelError(
                 ErrorCode.PRECONDITION_FAILED,
-                f"unknown delivery tag {tag}",
-                method.CLASS_ID, method.METHOD_ID)
+                f"unknown delivery tag {tag}", class_id, method_id)
+
+    def _fused_ack(self, raw, off: int, channel_id: int) -> int:
+        """basic.ack straight off the scan arrays (payload is exactly
+        class+method+tag8+bits1 = 13 bytes, no content follows): same
+        resolve/validate/settle steps as the generic Basic.Ack arm, minus
+        the Frame/Method/AMQCommand/coroutine scaffolding. Returns 1 when
+        handled, 0 to fall back (unknown channel: the generic path raises
+        the proper channel error)."""
+        channel = self.channels.get(channel_id)
+        if channel is None:
+            return 0
+        tag = int.from_bytes(raw[off + 4:off + 12], "big")
+        multiple = raw[off + 12] & 1 == 1
+        self._fused_skip = 1
+        deliveries = channel.resolve_tags(tag, multiple)
+        self._check_settled_raw(channel, deliveries, tag, multiple, 60, 80)
+        for delivery in deliveries:
+            channel.ack(delivery)
+        return 1
 
     def _arm_confirm(self, channel: ServerChannel) -> Optional[int]:
         self._has_published = True

@@ -913,7 +913,16 @@ class ClientChannel:
         return await self._wait((am.Basic.GetOk, am.Basic.GetEmpty))
 
     def basic_ack(self, delivery_tag: int, *, multiple: bool = False) -> None:
-        self._send(am.Basic.Ack(delivery_tag=delivery_tag, multiple=multiple))
+        # hand-assembled 21-byte frame (header + class/method + tag + bit +
+        # end): acks run once per consumed message in ack mode
+        if self.closed:
+            raise self.close_reason or ChannelClosedError(0, "closed")
+        self.client._write(
+            _FRAME_HDR(1, self.id, 13)
+            + b"\x00\x3c\x00\x50"
+            + delivery_tag.to_bytes(8, "big")
+            + (b"\x01" if multiple else b"\x00")
+            + b"\xce")
 
     def basic_nack(
         self, delivery_tag: int, *, multiple: bool = False, requeue: bool = True
