@@ -171,3 +171,78 @@ async def test_reject_unknown_tag_is_channel_error(client):
     await asyncio.sleep(0.2)
     assert ch.closed
     assert ch.close_reason.reply_code == 406
+
+
+async def test_tiny_reads_force_fused_fallback(monkeypatch):
+    """Every frame spanning multiple reads must route through the
+    assembler fallback of the fused scan loop (connection._consume_scan):
+    with 13-byte reads no publish triple is ever contained in one batch,
+    and with varied body sizes (0, small, > frame-max) the stateful
+    content machine sees every shape. Order and content must survive."""
+    from chanamq_tpu.broker.connection import AMQPConnection
+
+    orig = AMQPConnection._read_chunk
+
+    async def tiny_read(self):
+        data = await self.reader.read(13)
+        if not data:
+            return await orig(self)  # raise ConnectionClosed the same way
+        self._last_recv = asyncio.get_event_loop().time()
+        return data
+
+    monkeypatch.setattr(AMQPConnection, "_read_chunk", tiny_read)
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    ch = await c.channel()
+    await ch.confirm_select()
+    await ch.queue_declare("tiny_q")
+    bodies = [b"", b"x", b"hello world", bytes(range(256)) * 600,  # >128KB
+              b"tail-%d" % 7]
+    for body in bodies:
+        ch.basic_publish(body, routing_key="tiny_q")
+    await ch.wait_unconfirmed_below(1, timeout=30)
+    got, done = [], asyncio.get_event_loop().create_future()
+
+    def cb(m):
+        got.append(m.body)
+        ch.basic_ack(m.delivery_tag)
+        if len(got) >= len(bodies) and not done.done():
+            done.set_result(None)
+
+    await ch.basic_consume("tiny_q", cb)
+    await asyncio.wait_for(done, 30)
+    assert got == bodies
+    await c.close()
+    await srv.stop()
+
+
+async def test_interleaved_channel_content_frames(client):
+    """Content frames of two channels interleaved on one connection (legal
+    per AMQP §4.2.6 — interleaving is only forbidden WITHIN a channel):
+    the fused scan loop must fall back to the per-channel assembler and
+    deliver both messages intact."""
+    ch1 = await client.channel()
+    ch2 = await client.channel()
+    await ch1.queue_declare("il_q")
+    from chanamq_tpu.amqp.command import AMQCommand
+
+    f1 = AMQCommand(
+        ch1.id, am.Basic.Publish(exchange="", routing_key="il_q"),
+        body=b"from-ch1").render_frames(client.frame_max)
+    f2 = AMQCommand(
+        ch2.id, am.Basic.Publish(exchange="", routing_key="il_q"),
+        body=b"from-ch2").render_frames(client.frame_max)
+    # interleave: m1 m2 h1 h2 b1 b2 — one write so one scan batch sees all
+    wire = b"".join(f.to_bytes() for f in
+                    (f1[0], f2[0], f1[1], f2[1], f1[2], f2[2]))
+    client._write(wire)
+    got = []
+    for _ in range(100):
+        m = await ch1.basic_get("il_q", no_ack=True)
+        if m is not None:
+            got.append(m.body)
+        if len(got) >= 2:
+            break
+        await asyncio.sleep(0.02)
+    assert sorted(got) == [b"from-ch1", b"from-ch2"]
