@@ -119,6 +119,17 @@ class SqliteStore(StoreService):
 
     # -- group-commit engine ----------------------------------------------
 
+    def _enqueue(self, fn, fut, guard: bool) -> None:
+        """Append one op entry and schedule a kick — the single place the
+        seq-increment / append / coalescing-kick dance lives."""
+        self._op_seq += 1
+        self._pending.append((fn, fut, guard, self._op_seq))
+        if not self._flush_scheduled:
+            # coalesce everything submitted this loop tick into one batch
+            self._flush_scheduled = True
+            loop = self._loop or asyncio.get_running_loop()
+            loop.call_soon(self._kick)
+
     def _submit(
         self, fn: Callable[[sqlite3.Connection], T], guard: bool = True
     ) -> "asyncio.Future[T]":
@@ -131,12 +142,7 @@ class SqliteStore(StoreService):
         so a mid-op failure can't leave a partial effect in the batch."""
         loop = self._loop or asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._op_seq += 1
-        self._pending.append((fn, fut, guard, self._op_seq))
-        if not self._flush_scheduled:
-            # coalesce everything submitted this loop tick into one batch
-            self._flush_scheduled = True
-            loop.call_soon(self._kick)
+        self._enqueue(fn, fut, guard)
         return fut
 
     def _submit_nowait(self, fn: Callable[[sqlite3.Connection], Any],
@@ -146,12 +152,24 @@ class SqliteStore(StoreService):
         but no future/callback machinery — the per-message hot path
         (message blob + queue-log inserts) pays only a lambda and a list
         append. Failures are logged and recorded for barriers."""
-        self._op_seq += 1
-        self._pending.append((fn, None, guard, self._op_seq))
-        if not self._flush_scheduled:
-            self._flush_scheduled = True
-            loop = self._loop or asyncio.get_running_loop()
-            loop.call_soon(self._kick)
+        self._enqueue(fn, None, guard)
+
+    def _submit_row(self, sql: str, params: tuple) -> None:
+        """Fire-and-forget single-row statement, enqueued as (sql, params)
+        data instead of a callable: the writer thread coalesces rows with
+        the same SQL into one executemany per batch (one savepoint per
+        group), cutting per-row statement overhead on the per-message hot
+        ops.
+
+        ORDERING CONTRACT — weaker than _submit/_submit_nowait: rows with
+        the SAME SQL keep their relative order, and all rows execute before
+        the next callable op, but rows with DIFFERENT SQL may reorder
+        against each other within a batch. Only route a statement through
+        here if it commutes with every other _submit_row statement — in
+        practice: the statements must target distinct tables (today: msgs
+        vs queue_msgs). A same-table insert+delete pair would silently
+        swap; keep such ops on _submit/_submit_nowait."""
+        self._enqueue((sql, params), None, False)
 
     def _kick(self) -> None:
         self._flush_scheduled = False
@@ -177,7 +195,41 @@ class SqliteStore(StoreService):
                 loop.call_soon_threadsafe(
                     self._batch_done, [(f, None, exc, s) for _, f, _, s in batch])
                 return
+            # _submit_row ops accumulate into per-SQL groups, one
+            # executemany + savepoint per group. Rows with different SQL
+            # target different tables (or distinct keys) and commute; any
+            # opaque callable op is a reorder barrier — groups flush before
+            # it runs, so row-vs-callable order is preserved exactly. On a
+            # group failure every row in it reports failed — conservative
+            # (the rollback undoes all of them) and barrier-correct.
+            pending_rows: dict[str, tuple[list, list]] = {}
+
+            def flush_rows() -> None:
+                for sql, (rows, seqs) in pending_rows.items():
+                    try:
+                        db.execute("SAVEPOINT op")
+                        db.executemany(sql, rows)
+                        db.execute("RELEASE SAVEPOINT op")
+                        results.extend((None, None, None, s) for s in seqs)
+                    except Exception as exc:
+                        try:
+                            db.execute("ROLLBACK TO SAVEPOINT op")
+                            db.execute("RELEASE SAVEPOINT op")
+                        except Exception:  # pragma: no cover
+                            pass
+                        results.extend((None, None, exc, s) for s in seqs)
+                pending_rows.clear()
+
             for fn, fut, guard, seq in batch:
+                if type(fn) is tuple:
+                    entry = pending_rows.get(fn[0])
+                    if entry is None:
+                        entry = pending_rows[fn[0]] = ([], [])
+                    entry[0].append(fn[1])
+                    entry[1].append(seq)
+                    continue
+                if pending_rows:
+                    flush_rows()
                 if guard:
                     try:
                         db.execute("SAVEPOINT op")
@@ -196,6 +248,8 @@ class SqliteStore(StoreService):
                         results.append((fut, fn(db), None, seq))
                     except Exception as exc:
                         results.append((fut, None, exc, seq))
+            if pending_rows:
+                flush_rows()
             try:
                 db.execute("COMMIT")
             except Exception as exc:  # pragma: no cover - disk failure
@@ -341,19 +395,20 @@ class SqliteStore(StoreService):
 
     # -- messages ---------------------------------------------------------
 
+    _SQL_INSERT_MSG = "INSERT OR REPLACE INTO msgs VALUES (?,?,?,?,?,?,?)"
+
     @staticmethod
-    def _insert_message_op(msg: StoredMessage):
-        return lambda db: db.execute(
-            "INSERT OR REPLACE INTO msgs VALUES (?,?,?,?,?,?,?)",
-            (msg.id, msg.properties_raw, msg.body, msg.exchange,
-             msg.routing_key, msg.refer_count, msg.ttl_ms),
-        )
+    def _msg_row(msg: StoredMessage) -> tuple:
+        return (msg.id, msg.properties_raw, msg.body, msg.exchange,
+                msg.routing_key, msg.refer_count, msg.ttl_ms)
 
     def insert_message(self, msg: StoredMessage):
-        return self._submit(self._insert_message_op(msg), guard=False)
+        row = self._msg_row(msg)
+        return self._submit(
+            lambda db: db.execute(self._SQL_INSERT_MSG, row), guard=False)
 
     def insert_message_nowait(self, msg: StoredMessage) -> None:
-        self._submit_nowait(self._insert_message_op(msg))
+        self._submit_row(self._SQL_INSERT_MSG, self._msg_row(msg))
 
     @staticmethod
     def _row_to_message(row) -> StoredMessage:
@@ -463,21 +518,19 @@ class SqliteStore(StoreService):
 
     # -- queue log --------------------------------------------------------
 
-    @staticmethod
-    def _insert_queue_msg_op(vhost, queue, offset, msg_id, body_size, expire_at_ms):
-        return lambda db: db.execute(
-            "INSERT OR REPLACE INTO queue_msgs VALUES (?,?,?,?,?,?)",
-            (vhost, queue, offset, msg_id, body_size, expire_at_ms),
-        )
+    _SQL_INSERT_QUEUE_MSG = (
+        "INSERT OR REPLACE INTO queue_msgs VALUES (?,?,?,?,?,?)")
 
     def insert_queue_msg(self, vhost, queue, offset, msg_id, body_size, expire_at_ms):
-        return self._submit(self._insert_queue_msg_op(
-            vhost, queue, offset, msg_id, body_size, expire_at_ms), guard=False)
+        row = (vhost, queue, offset, msg_id, body_size, expire_at_ms)
+        return self._submit(
+            lambda db: db.execute(self._SQL_INSERT_QUEUE_MSG, row), guard=False)
 
     def insert_queue_msg_nowait(
             self, vhost, queue, offset, msg_id, body_size, expire_at_ms) -> None:
-        self._submit_nowait(self._insert_queue_msg_op(
-            vhost, queue, offset, msg_id, body_size, expire_at_ms))
+        self._submit_row(
+            self._SQL_INSERT_QUEUE_MSG,
+            (vhost, queue, offset, msg_id, body_size, expire_at_ms))
 
     def delete_queue_msg(self, vhost, queue, offset):
         return self._submit(lambda db: db.execute(
