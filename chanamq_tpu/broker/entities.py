@@ -216,11 +216,19 @@ class Queue:
 
     def clamp_expiry(self, message: Message) -> Optional[int]:
         """Effective expiry = now + min(per-message TTL, queue x-message-ttl)
-        (reference: QueueEntity.scala:288-297)."""
-        ttls = [t for t in (message.ttl_ms, self.ttl_ms) if t is not None]
-        if not ttls:
-            return None
-        return now_ms() + min(ttls)
+        (reference: QueueEntity.scala:288-297). Allocation-free: runs once
+        per enqueued message."""
+        mt = message.ttl_ms
+        qt = self.ttl_ms
+        if mt is None:
+            if qt is None:
+                return None
+            ttl = qt
+        elif qt is None or mt < qt:
+            ttl = mt
+        else:
+            ttl = qt
+        return now_ms() + ttl
 
     def push(self, message: Message, body_size: Optional[int] = None) -> QueuedMessage:
         # body_size is computed ONCE by the publisher and passed to every
@@ -333,12 +341,16 @@ class Queue:
         new_unacks: list[tuple[int, int, int, Optional[int]]] = []
         messages = self.messages
         while messages and self.consumers:
-            # one expire pass per iteration; head checks and the pop below
+            # expiry is checked on the head inline (no clock read for the
+            # overwhelming TTL-less case); head checks and the pop below
             # all act on the same entry, so no re-validation is needed
-            self._expire_head()
-            if not messages:
-                break
             qm = messages[0]
+            if qm.dead or (qm.expire_at_ms is not None
+                           and qm.expire_at_ms <= now_ms()):
+                self._expire_head()
+                if not messages:
+                    break
+                qm = messages[0]
             if qm.message.body is None:
                 # head is passivated: reattach bodies from the store first;
                 # dispatch resumes when the hydration pass completes

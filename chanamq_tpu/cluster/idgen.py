@@ -40,32 +40,32 @@ class IdGenerator:
         self._sequence = 0
 
     def next_id(self) -> int:
+        # runs once per published message; the uncontended lock stays for
+        # thread-safety but the id math lives inline, no extra call frame
         with self._lock:
-            return self._next_locked()
+            now = int(time.time() * 1000)
+            if now < self._last_ms:
+                raise ClockRegressionError(
+                    f"clock moved backwards: {self._last_ms - now} ms"
+                )
+            if now == self._last_ms:
+                self._sequence = (self._sequence + 1) & SEQUENCE_MASK
+                if self._sequence == 0:
+                    while now <= self._last_ms:
+                        now = int(time.time() * 1000)
+            else:
+                self._sequence = 0
+            self._last_ms = now
+            return (
+                ((now - EPOCH_MS) << TIMESTAMP_SHIFT)
+                | (self.worker_id << SEQUENCE_BITS)
+                | self._sequence
+            )
 
     def next_ids(self, n: int) -> list[int]:
-        with self._lock:
-            return [self._next_locked() for _ in range(n)]
-
-    def _next_locked(self) -> int:
-        now = int(time.time() * 1000)
-        if now < self._last_ms:
-            raise ClockRegressionError(
-                f"clock moved backwards: {self._last_ms - now} ms"
-            )
-        if now == self._last_ms:
-            self._sequence = (self._sequence + 1) & SEQUENCE_MASK
-            if self._sequence == 0:
-                while now <= self._last_ms:
-                    now = int(time.time() * 1000)
-        else:
-            self._sequence = 0
-        self._last_ms = now
-        return (
-            ((now - EPOCH_MS) << TIMESTAMP_SHIFT)
-            | (self.worker_id << SEQUENCE_BITS)
-            | self._sequence
-        )
+        # cold path (worker-lease batches): re-acquiring the uncontended
+        # lock per id keeps exactly one copy of the snowflake algorithm
+        return [self.next_id() for _ in range(n)]
 
     @staticmethod
     def timestamp_ms(message_id: int) -> int:
