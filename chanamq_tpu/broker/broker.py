@@ -671,6 +671,7 @@ class Broker:
         header_raw: Optional[bytes] = None,
         marks: Optional[list[tuple[int, int]]] = None,
         exrk_raw: Optional[bytes] = None,
+        pending: Optional[list] = None,
     ) -> tuple[bool, bool]:
         """Route one message. Returns (routed, deliverable):
         routed=False    -> mandatory handling applies,
@@ -683,7 +684,14 @@ class Broker:
         this publish's persistent writes (captured around the synchronous
         enqueue block, so no foreign connection's ops can land inside even
         when the clustered path awaits remote pushes) — pass them to
-        ``flush(intervals=...)`` for per-publisher failure attribution."""
+        ``flush(intervals=...)`` for per-publisher failure attribution.
+        pending, when given, pipelines plain clustered publishes: push
+        records BUFFER into it (nothing is sent here) and the CALLER's
+        batch barrier sends one queue.push_many per owner and awaits it —
+        per-read-batch RPC round trips instead of per-message ones.
+        mandatory/immediate publishes still await inline because their
+        Return semantics need the owner's answer (callers drain the buffer
+        first to keep per-queue FIFO)."""
         if self.cluster is None:
             return self.publish_sync(
                 vhost_name, exchange_name, routing_key, properties, body,
@@ -695,7 +703,7 @@ class Broker:
         return await self._publish_clustered(
             vhost, exchange_name, routing_key, properties, body,
             queue_names, mandatory=mandatory, immediate=immediate,
-            header_raw=header_raw, marks=marks)
+            header_raw=header_raw, marks=marks, pending=pending)
 
     def publish_sync(
         self,
@@ -822,6 +830,7 @@ class Broker:
         *, mandatory: bool, immediate: bool,
         header_raw: Optional[bytes] = None,
         marks: Optional[list[tuple[int, int]]] = None,
+        pending: Optional[list] = None,
     ) -> tuple[bool, bool]:
         """Cluster publish: routing already happened locally on the
         replicated exchange metadata; per-owner queue.push RPCs carry the
@@ -867,15 +876,30 @@ class Broker:
             if not had_consumer:
                 return (True, False)
         pushed_remote = False
-        for owner, names in by_owner.items():
-            try:
-                pushed, owner_had_consumer = await self.cluster.remote_push(
-                    owner, vhost.name, names, props_raw, body,
-                    exchange_name, routing_key, check_consumers=False)
-                pushed_remote = pushed_remote or pushed
-                had_consumer = had_consumer or owner_had_consumer
-            except Exception as exc:
-                log.warning("remote push to %s failed: %r", owner, exc)
+        if pending is not None and not mandatory and not immediate:
+            # pipelined: buffer the push record; the caller's batch barrier
+            # sends one queue.push_many per owner and awaits it — per-batch
+            # RPC round trips instead of per-message. routed is reported
+            # optimistically; a failed push surfaces at the barrier
+            # (confirm-mode: connection error, never a false confirm; else
+            # best-effort, logged)
+            for owner, names in by_owner.items():
+                pending.append((owner, {
+                    "vhost": vhost.name, "queues": names,
+                    "props_raw": props_raw, "body": body,
+                    "exchange": exchange_name, "routing_key": routing_key,
+                }))
+                pushed_remote = True
+        else:
+            for owner, names in by_owner.items():
+                try:
+                    pushed, owner_had_consumer = await self.cluster.remote_push(
+                        owner, vhost.name, names, props_raw, body,
+                        exchange_name, routing_key, check_consumers=False)
+                    pushed_remote = pushed_remote or pushed
+                    had_consumer = had_consumer or owner_had_consumer
+                except Exception as exc:
+                    log.warning("remote push to %s failed: %r", owner, exc)
         if not local and not pushed_remote:
             # every target was remote and none accepted: unroutable in effect
             return (False, True)

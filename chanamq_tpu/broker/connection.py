@@ -156,6 +156,15 @@ class AMQPConnection:
         # frames the current _fused_publish covered (so _consume_scan's
         # soft-error handlers resume past the failed publish's frames)
         self._fused_skip = 0
+        # buffered remote push records from this read batch (clustered
+        # pipelined publishes) — sent as one queue.push_many per owner and
+        # awaited at the batch barrier. _remote_strict marks that at least
+        # one buffered record came from a confirm-armed publish: only then
+        # does a drain failure escalate to a connection error (best-effort
+        # publishes just log, like the pre-pipelining inline path)
+        self._remote_pending: list = []
+        self._remote_strict = False
+        self._remote_failures: list = []
 
     # ------------------------------------------------------------------
     # output path
@@ -484,13 +493,40 @@ class AMQPConnection:
 
     async def _confirm_barrier(self) -> None:
         """Durability barrier before releasing publisher confirms: a confirm
-        may only reach the client once the store has committed every write
-        the confirmed publishes enqueued (message blob + queue-log rows —
-        all in one group-commit batch). Free for transient traffic: with no
-        enqueue windows recorded, flush([]) resolves immediately."""
+        may only reach the client once (a) every pipelined remote queue.push
+        of this batch has been accepted by its owner and (b) the store has
+        committed every write the confirmed publishes enqueued (message
+        blob + queue-log rows — all in one group-commit batch). Free for
+        single-node transient traffic: with no remote pushes and no enqueue
+        windows recorded, flush([]) resolves immediately."""
+        if self._remote_pending:
+            await self._drain_remote()
+        if self._remote_failures:
+            failures, self._remote_failures = self._remote_failures, []
+            strict = next((f for f, s in failures if s), None)
+            if strict is not None:
+                # never confirm over a lost confirm-armed remote push:
+                # drop the connection like a failed store barrier would
+                raise RuntimeError(
+                    f"remote push failed under confirm barrier: "
+                    f"{strict!r}") from strict
+            for failure, _ in failures:
+                log.warning("remote push failed (best-effort publish): %r",
+                            failure)
         if self._pending_confirms:
             intervals, self._confirm_marks = self._confirm_marks, []
             await self.broker.store.flush(intervals)
+
+    async def _drain_remote(self) -> None:
+        """Flush buffered remote push records: one queue.push_many RPC per
+        owner, awaited to completion. Failures collect for the barrier,
+        tagged with whether a confirm-armed publish was in the drained
+        batch (strictness is per-drain: a batched RPC can't attribute a
+        failure to individual records inside it)."""
+        records, self._remote_pending = self._remote_pending, []
+        strict, self._remote_strict = self._remote_strict, False
+        for failure in await self.broker.cluster.push_batch(records):
+            self._remote_failures.append((failure, strict))
 
     def _flush_confirms(self) -> None:
         if not self._pending_confirms:
@@ -535,6 +571,17 @@ class AMQPConnection:
 
     async def _teardown(self) -> None:
         self.closing = True
+        # buffered pipelined remote pushes: send them (the broker accepted
+        # these publishes pre-teardown; dropping them would lose messages)
+        # and log any failures best-effort
+        if self._remote_pending:
+            try:
+                await self._drain_remote()
+            except Exception as exc:  # pragma: no cover - teardown races
+                log.warning("remote drain failed during teardown: %r", exc)
+        for failure, _ in self._remote_failures:
+            log.warning("remote push failed during teardown: %r", failure)
+        self._remote_failures.clear()
         # requeue unacked, detach consumers
         for channel in list(self.channels.values()):
             channel.release_all()
@@ -600,6 +647,14 @@ class AMQPConnection:
 
     async def _dispatch(self, command: AMQCommand) -> None:
         method = command.method
+        if self._remote_pending and type(method) is not am.Basic.Publish:
+            # any non-publish command may issue an inline remote RPC
+            # (basic.get, queue purge/delete/stats, consume) or observe
+            # owner-side state: drain the pipelined publishes first so
+            # in-channel ordering holds (a get right after a publish must
+            # see the publish). Publishes keep buffering — _on_publish
+            # handles its own mandatory/immediate drain.
+            await self._drain_remote()
         if command.channel in self._closing_channels:
             # discard everything pipelined behind our Channel.Close until the
             # client acknowledges it
@@ -1064,8 +1119,13 @@ class AMQPConnection:
 
     async def _on_publish(self, channel: ServerChannel, command: AMQCommand) -> None:
         method = command.method
+        if (method.mandatory or method.immediate) and self._remote_pending:
+            # a mandatory/immediate publish awaits its remote push inline:
+            # drain the buffered pipeline first so per-queue FIFO holds
+            await self._drain_remote()
         props = command.properties or BasicProperties()
         seq = self._arm_confirm(channel)
+        buffered_before = len(self._remote_pending)
         routed, deliverable = await self.broker.publish(
             self.vhost_name, method.exchange, method.routing_key,
             props, command.body,
@@ -1073,7 +1133,10 @@ class AMQPConnection:
             header_raw=command.header_raw,
             marks=self._confirm_marks if seq is not None else None,
             exrk_raw=method._values.get("exrk_raw"),
+            pending=self._remote_pending,
         )
+        if seq is not None and len(self._remote_pending) > buffered_before:
+            self._remote_strict = True
         self._publish_aftermath(channel, command, props, routed, deliverable, seq)
 
     async def _on_consume(self, channel: ServerChannel, method: am.Basic.Consume) -> None:

@@ -378,6 +378,7 @@ class ClusterNode:
         rpc.register("queue.purge", self._h_queue_purge)
         rpc.register("queue.stats", self._h_queue_stats)
         rpc.register("queue.push", self._h_queue_push)
+        rpc.register("queue.push_many", self._h_queue_push_many)
         rpc.register("queue.get", self._h_queue_get)
         rpc.register("queue.consume", self._h_queue_consume)
         rpc.register("queue.cancel", self._h_queue_cancel)
@@ -572,6 +573,19 @@ class ClusterNode:
         return {"message_count": queue.message_count,
                 "consumer_count": queue.consumer_count}
 
+    async def _resolve_push_queues(
+        self, vhost: str, queue_names: list[str], body_len: int
+    ) -> tuple[list, bool]:
+        queues = []
+        had_consumer = False
+        for name in queue_names:
+            queue = await self.broker.activate_queue(vhost, name)
+            if queue is not None:
+                queues.append(queue)
+                if any(c.can_take(body_len) for c in queue.consumers):
+                    had_consumer = True
+        return queues, had_consumer
+
     async def _h_queue_push(self, payload: dict) -> dict:
         """Accept routed messages for locally-owned queues (the reference's
         QueueEntity.Push ask, QueueEntity.scala:271-316)."""
@@ -580,14 +594,8 @@ class ClusterNode:
         _, _, props = BasicProperties.decode_header(bytes(payload["props_raw"]))
         check_consumers = bool(payload.get("check_consumers"))
         body = bytes(payload["body"])
-        had_consumer = False
-        queues = []
-        for name in queue_names:
-            queue = await self.broker.activate_queue(vhost, name)
-            if queue is not None:
-                queues.append(queue)
-                if any(c.can_take(len(body)) for c in queue.consumers):
-                    had_consumer = True
+        queues, had_consumer = await self._resolve_push_queues(
+            vhost, queue_names, len(body))
         if bool(payload.get("check_only")):
             return {"pushed": False, "had_consumer": had_consumer}
         if check_consumers and not had_consumer:
@@ -604,6 +612,31 @@ class ClusterNode:
                 # (attributed to just this push's enqueue window)
                 await self.broker.store.flush(marks)
         return {"pushed": bool(queues), "had_consumer": had_consumer}
+
+    async def _h_queue_push_many(self, payload: dict) -> dict:
+        """Batched queue.push: one RPC carries a whole read batch of plain
+        pipelined publishes from one origin connection (order within the
+        RPC == publish order; the origin serializes batches at its confirm
+        barrier). One store flush covers every persistent push, so the
+        owner group-commits the batch exactly like local publishes."""
+        marks: list[tuple[int, int]] = []
+        any_persisted = False
+        for push in payload.get("pushes") or []:
+            vhost = str(push["vhost"])
+            names = [str(q) for q in push.get("queues") or []]
+            body = bytes(push["body"])
+            queues, _ = await self._resolve_push_queues(vhost, names, len(body))
+            if not queues:
+                continue
+            _, _, props = BasicProperties.decode_header(bytes(push["props_raw"]))
+            message = self.broker.push_local(
+                queues, props, body,
+                str(push["exchange"]), str(push["routing_key"]),
+                bytes(push["props_raw"]), marks)
+            any_persisted = any_persisted or message.persisted
+        if any_persisted:
+            await self.broker.store.flush(marks)
+        return {"ok": True}
 
     async def _h_queue_get(self, payload: dict) -> dict:
         queue = await self._local_queue(str(payload["vhost"]), str(payload["queue"]))
@@ -769,6 +802,23 @@ class ClusterNode:
         owner = self.queue_owner(vhost, name)
         reply = await self._call(owner, "queue.stats", {"vhost": vhost, "name": name})
         return int(reply.get("message_count", 0)), int(reply.get("consumer_count", 0))
+
+    async def push_batch(self, records: list) -> list[BaseException]:
+        """Send one queue.push_many RPC per owner covering a read batch of
+        pipelined publishes (records: (owner, push-payload) in publish
+        order). Returns RPC failures instead of raising — the caller's
+        barrier decides strictness (confirm mode: connection error;
+        best-effort: logged)."""
+        by_owner: dict[str, list[dict]] = {}
+        for owner, rec in records:
+            by_owner.setdefault(owner, []).append(rec)
+        tasks = [
+            asyncio.ensure_future(
+                self._call(owner, "queue.push_many", {"pushes": recs}))
+            for owner, recs in by_owner.items()
+        ]
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        return [r for r in results if isinstance(r, BaseException)]
 
     async def remote_push(
         self, owner: str, vhost: str, queues: list[str], props_raw: bytes,

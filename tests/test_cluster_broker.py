@@ -388,3 +388,54 @@ async def test_join_churn_no_loss_no_duplication(tmp_path):
             await node.stop()
         if joined is not None:
             await joined.stop()
+
+
+async def test_pipelined_remote_publish_order_and_confirms(tmp_path):
+    """Plain clustered publishes pipeline through one queue.push_many RPC
+    per owner per read batch (broker.py _publish_clustered pending path):
+    a burst published via a NON-owner must arrive complete and in order on
+    the owner, publisher confirms must release only after the owner
+    accepted the batch, and a mandatory publish mid-burst must drain the
+    buffered pipeline first so per-queue FIFO holds."""
+    nodes = await start_cluster(tmp_path, 2)
+    try:
+        owner, other = owner_and_other(nodes, "/", "pipe_q")
+        c = await AMQPClient.connect("127.0.0.1", other.port)
+        ch = await c.channel()
+        await ch.confirm_select()
+        await ch.queue_declare("pipe_q", durable=True)
+        n = 400
+        for i in range(n):
+            if i == 200:
+                # mandatory publish forces an inline remote push: the
+                # buffered 0..199 must be drained before it goes out
+                ch.basic_publish(b"m-%03d" % i, routing_key="pipe_q",
+                                 properties=PERSISTENT, mandatory=True)
+            else:
+                ch.basic_publish(b"m-%03d" % i, routing_key="pipe_q",
+                                 properties=PERSISTENT)
+        await ch.wait_unconfirmed_below(1, timeout=60)
+        q = owner.server.broker.vhosts["/"].queues["pipe_q"]
+        assert len(q.messages) == n
+        assert [qm.message.body for qm in q.messages] == \
+            [b"m-%03d" % i for i in range(n)]
+
+        # consume from the owner side: everything flows back out in order
+        c2 = await AMQPClient.connect("127.0.0.1", owner.port)
+        ch2 = await c2.channel()
+        got, done = [], asyncio.get_event_loop().create_future()
+
+        def cb(m):
+            got.append(m.body)
+            ch2.basic_ack(m.delivery_tag)
+            if len(got) >= n and not done.done():
+                done.set_result(None)
+
+        await ch2.basic_consume("pipe_q", cb)
+        await asyncio.wait_for(done, 30)
+        assert got == [b"m-%03d" % i for i in range(n)]
+        await c2.close()
+        await c.close()
+    finally:
+        for node in nodes:
+            await node.stop()
