@@ -74,6 +74,9 @@ class ClusterNode:
         # origin-side registry of remote consumers for failover re-register:
         # (vhost, queue, tag) -> info
         self._remote_consumers: dict[tuple[str, str, str], dict] = {}
+        # per-tick settle coalescing: (owner, vhost, queue, op, tag) ->
+        # [offsets, credit] flushed as one queue.settle RPC each
+        self._settle_buf: dict[tuple, list] = {}
         self.name: str = ""
         broker.cluster = self
         self._register_handlers()
@@ -349,6 +352,11 @@ class ClusterNode:
 
     async def _call(self, node: str, method: str, payload: dict) -> dict:
         assert self.membership is not None
+        if self._settle_buf and method != "queue.settle":
+            # buffered settles precede any subsequent RPC: a cancel /
+            # delete / purge issued after an ack in the same read batch
+            # must find the ack applied on the owner
+            await self._drain_settles()
         return await self.membership.client(node).call(method, payload)
 
     async def _event(self, node: str, method: str, payload: dict) -> None:
@@ -891,17 +899,47 @@ class ClusterNode:
 
     def settle_bg(self, vhost: str, name: str, op: str, offsets: list[int],
                   tag: str = "", credit: int = 0) -> None:
+        """Fire-and-forget settle (ack/drop/requeue) toward the queue
+        owner. Settles coalesce per (owner, queue, op, tag) within one
+        loop tick — a consumer acking a whole read batch costs one RPC,
+        not one per message; the owner's queue.settle handler already
+        takes offset lists."""
         owner = self.queue_owner(vhost, name)
+        key = (owner, vhost, name, op, tag)
+        if not self._settle_buf:  # first settle this tick: schedule flush
+            asyncio.get_event_loop().call_soon(self._flush_settles)
+        entry = self._settle_buf.get(key)
+        if entry is None:
+            self._settle_buf[key] = entry = [[], 0]
+        entry[0].extend(offsets)
+        entry[1] += credit
 
-        async def _settle() -> None:
-            try:
-                await self._call(owner, "queue.settle", {
-                    "vhost": vhost, "queue": name, "op": op,
-                    "offsets": offsets, "tag": tag, "credit": credit})
-            except (RpcError, OSError) as exc:
-                log.warning("settle %s %s failed: %s", op, offsets, exc)
+    def _flush_settles(self) -> None:
+        # the buffer is swapped only inside _drain_settles, at task
+        # EXECUTION time: any competing RPC whose task runs before the
+        # drain task still sees a full buffer and drains inline first
+        # (_call), so settle-before-X order holds in every interleaving
+        if self._settle_buf:
+            asyncio.get_event_loop().create_task(self._drain_settles())
 
-        asyncio.get_event_loop().create_task(_settle())
+    async def _drain_settles(self) -> None:
+        """Send buffered settles NOW, inline — called before any other
+        outbound RPC so a settle enqueued first reaches the owner first
+        (e.g. ack-then-cancel in one read batch must not requeue the acked
+        message; _call invokes this, and _settle_one's own _call finds the
+        buffer already empty)."""
+        buf, self._settle_buf = self._settle_buf, {}
+        for (owner, vhost, name, op, tag), (offsets, credit) in buf.items():
+            await self._settle_one(owner, vhost, name, op, tag, offsets, credit)
+
+    async def _settle_one(self, owner: str, vhost: str, name: str, op: str,
+                          tag: str, offsets: list[int], credit: int) -> None:
+        try:
+            await self._call(owner, "queue.settle", {
+                "vhost": vhost, "queue": name, "op": op,
+                "offsets": offsets, "tag": tag, "credit": credit})
+        except (RpcError, OSError) as exc:
+            log.warning("settle %s %s failed: %s", op, offsets, exc)
 
 
 class RemoteConsumer:
