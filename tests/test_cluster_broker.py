@@ -439,3 +439,43 @@ async def test_pipelined_remote_publish_order_and_confirms(tmp_path):
     finally:
         for node in nodes:
             await node.stop()
+
+
+async def test_remote_ack_then_cancel_not_inverted(tmp_path):
+    """Settle coalescing (cluster/node.py settle_bg) must never let a
+    cancel overtake an ack buffered in the same read batch: the owner
+    would requeue the just-acked delivery and redeliver it. The drain-
+    before-RPC rule in ClusterNode._call pins the order."""
+    nodes = await start_cluster(tmp_path, 2)
+    try:
+        owner, other = owner_and_other(nodes, "/", "ac_q")
+        c = await AMQPClient.connect("127.0.0.1", other.port)
+        ch = await c.channel()
+        await ch.queue_declare("ac_q")
+        cp = await AMQPClient.connect("127.0.0.1", owner.port)
+        chp = await cp.channel()
+        await chp.confirm_select()
+
+        got, first = [], asyncio.get_event_loop().create_future()
+
+        def cb(m):
+            got.append(m)
+            if not first.done():
+                first.set_result(None)
+
+        await ch.basic_consume("ac_q", cb)
+        chp.basic_publish(b"only", routing_key="ac_q")
+        await chp.wait_unconfirmed_below(1)
+        await asyncio.wait_for(first, 15)
+        ch.basic_ack(got[0].delivery_tag)
+        await ch.basic_cancel(got[0].consumer_tag)
+        await asyncio.sleep(0.5)
+        q = owner.server.broker.vhosts["/"].queues["ac_q"]
+        assert not q.outstanding
+        assert len(q.messages) == 0
+        assert await ch.basic_get("ac_q", no_ack=True) is None
+        await c.close()
+        await cp.close()
+    finally:
+        for node in nodes:
+            await node.stop()
