@@ -26,6 +26,7 @@ import asyncio
 import json
 import os
 import socket
+import shutil
 import subprocess
 import sys
 import tempfile
@@ -308,6 +309,102 @@ def run_spec(name: str, rate: int = 0) -> dict:
     }
 
 
+async def _cluster_spec() -> dict:
+    """Two in-process nodes sharing a store: publish a burst via the
+    NON-owner (batch-pipelined queue.push_many), then consume remotely
+    (per-tick deliver_many events). Evidence for the cluster fast paths;
+    in-process, so both nodes share this one core."""
+    from chanamq_tpu.broker.server import BrokerServer
+    from chanamq_tpu.client import AMQPClient
+    from chanamq_tpu.cluster.node import ClusterNode
+    from chanamq_tpu.store.sqlite import SqliteStore
+
+    tmpdir = tempfile.mkdtemp(prefix="bench-cluster-")
+    store = os.path.join(tmpdir, "shared.db")
+
+    async def start_node(seeds):
+        srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                           store=SqliteStore(store))
+        await srv.start()
+        cl = ClusterNode(srv.broker, "127.0.0.1", 0, seeds,
+                         heartbeat_interval_s=0.2, failure_timeout_s=5)
+        await cl.start()
+        return srv, cl
+
+    a_srv = a_cl = b_srv = b_cl = None
+    try:
+        a_srv, a_cl = await start_node([])
+        b_srv, b_cl = await start_node([a_cl.name])
+        for _ in range(100):
+            if (len(a_cl.membership.alive_members()) == 2
+                    and len(b_cl.membership.alive_members()) == 2):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise RuntimeError("2-node membership did not converge")
+        qn = next(f"bq{i}" for i in range(200)
+                  if a_cl.queue_owner("/", f"bq{i}") == b_cl.name)
+        n = 5000
+        body = b"x" * BODY_BYTES
+
+        # publish via non-owner A -> owner B, confirmed
+        c = await AMQPClient.connect("127.0.0.1", a_srv.bound_port)
+        ch = await c.channel()
+        await ch.confirm_select()
+        await ch.queue_declare(qn)
+        # the owner's metadata broadcast is fire-and-forget: wait for A to
+        # learn the queue exists, else default-exchange publishes racing
+        # the replication are silently unroutable
+        for _ in range(100):
+            if ("/", qn) in a_cl.queue_metas:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise RuntimeError(f"queue meta for {qn} never replicated")
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ch.basic_publish(body, routing_key=qn)
+        await ch.wait_unconfirmed_below(1, timeout=60)
+        publish_rate = n / (time.perf_counter() - t0)
+
+        # consume the backlog remotely: owner B -> origin A
+        loop = asyncio.get_event_loop()
+        got = 0
+        done = loop.create_future()
+
+        def cb(m):
+            nonlocal got
+            got += 1
+            if got >= n and not done.done():
+                done.set_result(None)
+
+        t0 = time.perf_counter()
+        await ch.basic_consume(qn, cb, no_ack=True)
+        await asyncio.wait_for(done, 60)
+        consume_rate = n / (time.perf_counter() - t0)
+        await c.close()
+        return {
+            "publish_via_nonowner_msgs_per_s": round(publish_rate, 1),
+            "remote_consume_msgs_per_s": round(consume_rate, 1),
+            "messages": n,
+        }
+    finally:
+        for part in (b_cl, b_srv, a_cl, a_srv):
+            if part is not None:
+                try:
+                    await part.stop()
+                except Exception:
+                    pass
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def run_cluster_spec() -> dict:
+    try:
+        return asyncio.run(asyncio.wait_for(_cluster_spec(), timeout=120))
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def main() -> None:
     if "--role" in sys.argv:
         import argparse
@@ -351,6 +448,10 @@ def main() -> None:
         results[PACED_SPEC] = run_spec(PACED_SPEC, rate=paced_rate)
         results[PACED_SPEC]["rate"] = paced_rate
         print(f"# {PACED_SPEC}: {results[PACED_SPEC]}", file=sys.stderr)
+    cluster = None
+    if which == "all":
+        cluster = run_cluster_spec()
+        print(f"# cluster_2node: {cluster}", file=sys.stderr)
     line = {
         "metric": "amqp_delivered_msgs_per_s_transient_autoack_3p3c",
         "value": headline.get("delivered_per_s"),
@@ -363,7 +464,11 @@ def main() -> None:
         "seconds": BENCH_SECONDS,
         "specs": results,
     }
+    if cluster is not None:
+        line["cluster_2node"] = cluster
     spec_errors = {n: r["error"] for n, r in results.items() if "error" in r}
+    if cluster is not None and "error" in cluster:
+        spec_errors["cluster_2node"] = cluster["error"]
     if spec_errors:
         line["error"] = spec_errors
     print(json.dumps(line))
