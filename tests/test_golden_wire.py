@@ -122,6 +122,29 @@ async def expect_bytes(reader: asyncio.StreamReader, expected: bytes, what: str)
     )
 
 
+async def handshake(reader, writer, *, heartbeat: int = 0,
+                    open_channel: bool = True) -> tuple[int, int]:
+    """Non-golden handshake setup (the canonical-session test asserts these
+    bytes; tests focused elsewhere reuse this): protocol header -> StartOk
+    -> Tune -> TuneOk -> Connection.Open -> OpenOk [-> Channel.Open(1)]."""
+    writer.write(b"AMQP\x00\x00\x09\x01")
+    await read_frame(reader)  # Connection.Start
+    writer.write(method_frame(0, 10, 11,
+        table() + shortstr("PLAIN") + longstr(b"\x00guest\x00guest")
+        + shortstr("en_US")))
+    _, _, payload = await read_frame(reader)  # Connection.Tune
+    channel_max, frame_max, _ = struct.unpack(">HIH", payload[4:12])
+    writer.write(method_frame(0, 10, 31,
+        struct.pack(">HIH", channel_max, frame_max, heartbeat)))
+    writer.write(method_frame(0, 10, 40,
+        shortstr("/") + shortstr("") + b"\x00"))
+    await read_frame(reader)  # Connection.OpenOk
+    if open_channel:
+        writer.write(method_frame(1, 20, 10, shortstr("")))
+        await read_frame(reader)  # Channel.OpenOk
+    return channel_max, frame_max
+
+
 # ---------------------------------------------------------------------------
 # the test
 # ---------------------------------------------------------------------------
@@ -332,20 +355,72 @@ async def test_golden_wire_heartbeat_and_bad_header():
         # (b) negotiate a 1s heartbeat, then sit idle and expect the server's
         # heartbeat frame: exactly 08 0000 00000000 CE
         reader, writer = await asyncio.open_connection("127.0.0.1", srv.bound_port)
-        writer.write(b"AMQP\x00\x00\x09\x01")
-        await read_frame(reader)  # Start
-        writer.write(method_frame(0, 10, 11,
-            table() + shortstr("PLAIN") + longstr(b"\x00guest\x00guest")
-            + shortstr("en_US")))
-        ftype, _, payload = await read_frame(reader)  # Tune
-        channel_max, frame_max, _ = struct.unpack(">HIH", payload[4:12])
-        writer.write(method_frame(0, 10, 31,
-            struct.pack(">HIH", channel_max, frame_max, 1)))  # heartbeat 1s
-        writer.write(method_frame(0, 10, 40,
-            shortstr("/") + shortstr("") + b"\x00"))
-        await read_frame(reader)  # OpenOk
+        await handshake(reader, writer, heartbeat=1, open_channel=False)
         await expect_bytes(reader, b"\x08\x00\x00\x00\x00\x00\x00\xce",
                            "heartbeat frame")
         writer.close()
     finally:
+        await srv.stop()
+
+
+async def test_golden_wire_confirms_and_mandatory_return():
+    """Publisher-confirm and mandatory-return wire shapes: confirm.select ->
+    select-ok; a pipelined burst of publishes is confirmed with ONE
+    Basic.Ack(multiple=1) carrying the batch's highest seq (the server's
+    documented coalescing, mirroring the reference's run-length confirm
+    logic, FrameStage.scala:571-596); a mandatory publish to an unroutable
+    key comes back as Basic.Return + the untouched header and body."""
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    reader, writer = await asyncio.open_connection("127.0.0.1", srv.bound_port)
+    try:
+        await handshake(reader, writer)
+        writer.write(method_frame(1, 50, 10,    # queue.declare default-bound
+            struct.pack(">H", 0) + shortstr("cf.q") + b"\x00" + table()))
+        await read_frame(reader)  # DeclareOk
+
+        # confirm.select -> select-ok byte-exact (class 85, methods 10/11)
+        writer.write(method_frame(1, 85, 10, b"\x00"))  # no-wait=0
+        await expect_bytes(reader,
+            method_frame(1, 85, 11, b""), "confirm.select-ok")
+
+        # three pipelined publishes to the default exchange -> ONE coalesced
+        # Basic.Ack with delivery-tag 3, multiple=1
+        publish = (
+            method_frame(1, 60, 40,
+                struct.pack(">H", 0) + shortstr("") + shortstr("cf.q")
+                + b"\x00")
+            + content_header_frame(1, len(BODY), 0x1000, bytes([1]))
+            + body_frame(1, BODY))
+        writer.write(publish * 3)
+        await expect_bytes(reader,
+            method_frame(1, 60, 80, struct.pack(">Q", 3) + b"\x01"),
+            "coalesced publisher confirm (tag 3, multiple)")
+
+        # mandatory publish to an unroutable key: Basic.Return 312 NO_ROUTE
+        # + the header and body echoed byte-for-byte, then its own confirm
+        writer.write(
+            method_frame(1, 60, 40,
+                struct.pack(">H", 0) + shortstr("") + shortstr("no.such.q")
+                + b"\x01")           # mandatory=1
+            + content_header_frame(1, len(BODY), ALL_14_FLAGS, ALL_14_PROPS)
+            + body_frame(1, BODY))
+        await expect_bytes(reader,
+            method_frame(1, 60, 50,
+                struct.pack(">H", 312) + shortstr("NO_ROUTE")
+                + shortstr("") + shortstr("no.such.q")),
+            "basic.return")
+        await expect_bytes(reader,
+            content_header_frame(1, len(BODY), ALL_14_FLAGS, ALL_14_PROPS),
+            "returned content header")
+        await expect_bytes(reader, body_frame(1, BODY), "returned body")
+        await expect_bytes(reader,
+            method_frame(1, 60, 80, struct.pack(">Q", 4) + b"\x01"),
+            "confirm for the returned publish")
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
         await srv.stop()
