@@ -114,6 +114,8 @@ class AdminServer:
                 return "200 OK", self._queues(segments[1])
             if len(segments) == 2 and segments[0] == "exchanges":
                 return "200 OK", self._exchanges(segments[1])
+            if segments == ["cluster"]:
+                return "200 OK", self._cluster()
         except Exception as exc:
             return "500 Internal Server Error", {"error": str(exc)}
         return "404 Not Found", {"error": "unknown path"}
@@ -151,6 +153,30 @@ class AdminServer:
             }
             for queue in vhost.queues.values()
         ]
+
+    def _cluster(self) -> dict:
+        """Cluster membership + queue ownership as the operator sees it
+        (exceeds the reference, whose admin surface was vhost-only)."""
+        cluster = self.broker.cluster
+        if cluster is None or cluster.membership is None:
+            # membership is None until ClusterNode.start() completes: report
+            # disabled rather than 500 in that window
+            return {"enabled": False}
+        owned = sum(
+            1 for (vhost, name) in cluster.queue_metas
+            if cluster.owns_queue(vhost, name))
+        return {
+            "enabled": True,
+            "self": cluster.name,
+            "members": {
+                name: {"status": member.status,
+                       "incarnation": member.incarnation}
+                for name, member in cluster.membership.members.items()
+            },
+            "alive": cluster.membership.alive_members(),
+            "known_queues": len(cluster.queue_metas),
+            "owned_queues": owned,
+        }
 
     def _exchanges(self, vhost_name: str) -> list:
         vhost = self.broker.vhosts.get(vhost_name)
