@@ -26,18 +26,38 @@ from ..amqp.properties import BasicProperties
 _FRAME_HDR = struct.Struct(">BHI").pack
 
 
+_DELIVER_CTAG_CACHE: dict[bytes, str] = {}
+_DELIVER_EXRK_CACHE: dict[bytes, tuple[str, str]] = {}
+
+
 def _parse_deliver_fields(payload: bytes) -> tuple[str, int, bool, str, str]:
-    """Hand-parse a basic.deliver method payload (past the 4 id bytes)."""
-    pos = 4
-    n = payload[pos]; pos += 1
-    consumer_tag = payload[pos:pos + n].decode("utf-8"); pos += n
-    delivery_tag = int.from_bytes(payload[pos:pos + 8], "big"); pos += 8
-    redelivered = bool(payload[pos] & 1); pos += 1
-    n = payload[pos]; pos += 1
-    exchange = payload[pos:pos + n].decode("utf-8"); pos += n
-    n = payload[pos]; pos += 1
-    routing_key = payload[pos:pos + n].decode("utf-8")
-    return consumer_tag, delivery_tag, redelivered, exchange, routing_key
+    """Hand-parse a basic.deliver method payload (past the 4 id bytes).
+
+    A consumer's tag and a flow's exchange/routing-key repeat on every
+    delivery, so their str decodes are cached keyed by the raw byte slices
+    (prefix: ids + consumer-tag; suffix: exchange + routing-key) — a steady
+    stream pays two dict hits instead of three utf-8 decodes per message."""
+    n = payload[4]
+    split = 5 + n
+    prefix = payload[:split]
+    ctag = _DELIVER_CTAG_CACHE.get(prefix)
+    if ctag is None:
+        if len(_DELIVER_CTAG_CACHE) >= 1024:
+            _DELIVER_CTAG_CACHE.clear()
+        ctag = _DELIVER_CTAG_CACHE[prefix] = payload[5:split].decode("utf-8")
+    delivery_tag = int.from_bytes(payload[split:split + 8], "big")
+    redelivered = bool(payload[split + 8] & 1)
+    suffix = payload[split + 9:]
+    exrk = _DELIVER_EXRK_CACHE.get(suffix)
+    if exrk is None:
+        if len(_DELIVER_EXRK_CACHE) >= 1024:
+            _DELIVER_EXRK_CACHE.clear()
+        pos = 1 + suffix[0]
+        exchange = suffix[1:pos].decode("utf-8")
+        n2 = suffix[pos]
+        routing_key = suffix[pos + 1:pos + 1 + n2].decode("utf-8")
+        exrk = _DELIVER_EXRK_CACHE[suffix] = (exchange, routing_key)
+    return ctag, delivery_tag, redelivered, exrk[0], exrk[1]
 
 
 class AMQPClientError(Exception):
