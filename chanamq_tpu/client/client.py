@@ -28,6 +28,11 @@ _FRAME_HDR = struct.Struct(">BHI").pack
 
 _DELIVER_CTAG_CACHE: dict[bytes, str] = {}
 _DELIVER_EXRK_CACHE: dict[bytes, tuple[str, str]] = {}
+# high-cardinality routing keys (per-message unique, e.g. correlation-id
+# routing) would turn the exrk cache into pure per-message overhead: after
+# repeated churn-driven clears the cache disables itself for the process
+_EXRK_CACHE_STRIKES = 4
+_exrk_strikes = 0
 
 
 def _parse_deliver_fields(payload: bytes) -> tuple[str, int, bool, str, str]:
@@ -37,6 +42,7 @@ def _parse_deliver_fields(payload: bytes) -> tuple[str, int, bool, str, str]:
     delivery, so their str decodes are cached keyed by the raw byte slices
     (prefix: ids + consumer-tag; suffix: exchange + routing-key) — a steady
     stream pays two dict hits instead of three utf-8 decodes per message."""
+    global _exrk_strikes
     n = payload[4]
     split = 5 + n
     prefix = payload[:split]
@@ -47,16 +53,23 @@ def _parse_deliver_fields(payload: bytes) -> tuple[str, int, bool, str, str]:
         ctag = _DELIVER_CTAG_CACHE[prefix] = payload[5:split].decode("utf-8")
     delivery_tag = int.from_bytes(payload[split:split + 8], "big")
     redelivered = bool(payload[split + 8] & 1)
-    suffix = payload[split + 9:]
-    exrk = _DELIVER_EXRK_CACHE.get(suffix)
+    exrk = None
+    if _exrk_strikes < _EXRK_CACHE_STRIKES:
+        suffix = payload[split + 9:]
+        exrk = _DELIVER_EXRK_CACHE.get(suffix)
     if exrk is None:
-        if len(_DELIVER_EXRK_CACHE) >= 1024:
-            _DELIVER_EXRK_CACHE.clear()
-        pos = 1 + suffix[0]
-        exchange = suffix[1:pos].decode("utf-8")
-        n2 = suffix[pos]
-        routing_key = suffix[pos + 1:pos + 1 + n2].decode("utf-8")
-        exrk = _DELIVER_EXRK_CACHE[suffix] = (exchange, routing_key)
+        pos = split + 9
+        n2 = payload[pos]
+        exchange = payload[pos + 1:pos + 1 + n2].decode("utf-8")
+        pos += 1 + n2
+        n2 = payload[pos]
+        routing_key = payload[pos + 1:pos + 1 + n2].decode("utf-8")
+        exrk = (exchange, routing_key)
+        if _exrk_strikes < _EXRK_CACHE_STRIKES:
+            if len(_DELIVER_EXRK_CACHE) >= 1024:
+                _DELIVER_EXRK_CACHE.clear()
+                _exrk_strikes += 1
+            _DELIVER_EXRK_CACHE[suffix] = exrk
     return ctag, delivery_tag, redelivered, exrk[0], exrk[1]
 
 

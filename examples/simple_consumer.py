@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""Manual smoke consumer — the rebuild's analogue of the reference's
+SimpleConsumer (chana-mq-test .../SimpleConsumer.scala:9-68): subscribe to
+test_queue with autoAck and print deliveries for 20 seconds.
+
+Usage: python examples/simple_consumer.py [host] [port]
+"""
+
+import asyncio
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from chanamq_tpu.client import AMQPClient
+
+QUEUE = "test_queue"
+LIFETIME_S = 20
+
+
+async def main() -> None:
+    host = sys.argv[1] if len(sys.argv) > 1 else "127.0.0.1"
+    port = int(sys.argv[2]) if len(sys.argv) > 2 else 5672
+    conn = await AMQPClient.connect(host, port)
+    ch = await conn.channel()
+    print("going to consume...")
+
+    def on_message(msg) -> None:
+        print(f"Got {msg.body.decode(errors='replace')} "
+              f"(tag={msg.delivery_tag}, exchange={msg.exchange!r}, "
+              f"routing_key={msg.routing_key!r})")
+
+    await ch.basic_consume(QUEUE, on_message, no_ack=True)
+    await asyncio.sleep(LIFETIME_S)
+    print("closing ...")
+    await conn.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

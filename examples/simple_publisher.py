@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""Manual smoke publisher — the rebuild's analogue of the reference's
+SimplePublisher (chana-mq-test .../SimplePublisher.scala:24-61): declare a
+durable direct exchange and a durable queue with x-message-ttl=60000, bind,
+and publish five messages across three property shapes (persistent,
+persistent+expiration, bare).
+
+Usage: python examples/simple_publisher.py [host] [port]
+(start a broker first: chanamq-server --port 5672, or
+ python -m chanamq_tpu.broker.server --port 5672)
+"""
+
+import asyncio
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from chanamq_tpu.amqp.properties import BasicProperties
+from chanamq_tpu.client import AMQPClient
+
+EXCHANGE = "test_exchange"
+QUEUE = "test_queue"
+ROUTING_KEY = "quote"
+
+
+async def main() -> None:
+    host = sys.argv[1] if len(sys.argv) > 1 else "127.0.0.1"
+    port = int(sys.argv[2]) if len(sys.argv) > 2 else 5672
+    conn = await AMQPClient.connect(host, port)
+    ch = await conn.channel()
+    await ch.confirm_select()
+
+    await ch.exchange_declare(EXCHANGE, "direct", durable=True)
+    ok = await ch.queue_declare(
+        QUEUE, durable=True, arguments={"x-message-ttl": 60000})
+    print(f"declare queue: {ok.queue}")
+    await ch.queue_bind(QUEUE, EXCHANGE, ROUTING_KEY)
+
+    props_persistent = BasicProperties(delivery_mode=2)
+    props_expiring = BasicProperties(delivery_mode=2, expiration="100000")
+    shapes = [props_persistent, props_expiring, None, None, None]
+    for i, props in enumerate(shapes):
+        ch.basic_publish(b"Hello, world%d" % i, exchange=EXCHANGE,
+                         routing_key=ROUTING_KEY, properties=props)
+        print("published")
+    await ch.wait_unconfirmed_below(1)
+    print("confirmed; closing ...")
+    await conn.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
