@@ -54,8 +54,16 @@ class BrokerServer:
         self._servers: list[asyncio.AbstractServer] = []
         self._connections: set[AMQPConnection] = set()
 
-    async def start(self) -> None:
+    async def start(self, *, listen: bool = True) -> None:
+        """Start the broker and (by default) open the listeners. Pass
+        listen=False to defer the listeners until other layers are live —
+        run_node starts the cluster first so no client ever connects to a
+        half-clustered node."""
         await self.broker.start()
+        if listen:
+            await self.start_listeners()
+
+    async def start_listeners(self) -> None:
         server = await asyncio.start_server(
             self._on_client, self.host, self.port, backlog=self.backlog)
         self._servers.append(server)
@@ -194,7 +202,10 @@ async def run_node(config) -> None:
     cluster = None
     started = False
     try:
-        await server.start()
+        # boot order matters: broker state, then the cluster layer, then
+        # the AMQP listeners — a client accepted before the cluster is live
+        # would see a node that mis-routes clustered queues
+        await server.start(listen=False)
         started = True
         if config.bool("chana.mq.cluster.enabled"):
             from ..cluster.node import ClusterNode
@@ -211,6 +222,7 @@ async def run_node(config) -> None:
                     "chana.mq.cluster.failure-timeout") or 5.0,
             )
             await cluster.start()
+        await server.start_listeners()
         if config.bool("chana.mq.admin.enabled"):
             admin = AdminServer(
                 server.broker,

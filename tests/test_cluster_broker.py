@@ -479,3 +479,143 @@ async def test_remote_ack_then_cancel_not_inverted(tmp_path):
     finally:
         for node in nodes:
             await node.stop()
+
+
+async def test_two_process_cluster_end_to_end(tmp_path):
+    """The full multi-host shape, no in-process shortcuts: two REAL broker
+    processes booted from config (run_node: AMQP listener + cluster layer),
+    gossiping over real sockets, sharing one store. A client on node A
+    publishes into a queue owned by whichever node the ring picks; a client
+    on the OTHER node consumes it all back. Validates the config-driven
+    cluster wiring (server.from_config + ClusterNode seeds) that the
+    in-process tests bypass."""
+    import json as jsonlib
+    import socket
+    import subprocess
+    import sys
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    store = str(tmp_path / "shared.db")
+    a_amqp, a_cluster = free_port(), free_port()
+    b_amqp, b_cluster = free_port(), free_port()
+
+    a_admin, b_admin = free_port(), free_port()
+
+    def node_cfg(amqp_port, cluster_port, admin_port, seeds):
+        return {
+            "chana.mq.amqp.interface": "127.0.0.1",
+            "chana.mq.amqp.port": amqp_port,
+            "chana.mq.admin.enabled": True,
+            "chana.mq.admin.interface": "127.0.0.1",
+            "chana.mq.admin.port": admin_port,
+            "chana.mq.store.path": store,
+            "chana.mq.cluster.enabled": True,
+            "chana.mq.cluster.host": "127.0.0.1",
+            "chana.mq.cluster.port": cluster_port,
+            "chana.mq.cluster.seeds": seeds,
+            "chana.mq.cluster.heartbeat-interval": "200ms",
+            "chana.mq.cluster.failure-timeout": "5s",
+        }
+
+    async def admin_cluster(port):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET /admin/cluster HTTP/1.1\r\nHost: x\r\n\r\n")
+        # the admin server closes after responding: read to EOF
+        raw = await asyncio.wait_for(reader.read(-1), 5)
+        writer.close()
+        return jsonlib.loads(raw.partition(b"\r\n\r\n")[2])
+
+    procs = []
+    logs = []
+    try:
+        for amqp_port, cluster_port, admin_port, seeds in (
+                (a_amqp, a_cluster, a_admin, []),
+                (b_amqp, b_cluster, b_admin, [f"127.0.0.1:{a_cluster}"])):
+            cfg_path = tmp_path / f"node{amqp_port}.json"
+            cfg_path.write_text(jsonlib.dumps(
+                node_cfg(amqp_port, cluster_port, admin_port, seeds)))
+            log_file = open(tmp_path / f"node{amqp_port}.log", "w")
+            logs.append(log_file)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "chanamq_tpu.broker.server",
+                 "--config", str(cfg_path), "--log-level", "WARNING"],
+                stdout=log_file, stderr=subprocess.STDOUT))
+
+        def check_alive():
+            from pathlib import Path
+
+            for proc, log_file in zip(procs, logs):
+                if proc.poll() is not None:
+                    log_file.flush()
+                    tail = Path(log_file.name).read_text()[-1500:]
+                    raise RuntimeError(
+                        f"node died rc={proc.returncode}: {tail}")
+
+        # converge: both processes report 2 alive members over admin HTTP
+        for _ in range(150):
+            check_alive()
+            try:
+                va = await admin_cluster(a_admin)
+                vb = await admin_cluster(b_admin)
+                if (va.get("enabled") and vb.get("enabled")
+                        and len(va["alive"]) == 2 and len(vb["alive"]) == 2):
+                    break
+            except (OSError, ValueError, asyncio.TimeoutError):
+                pass
+            await asyncio.sleep(0.1)
+        else:
+            raise RuntimeError("2-process membership never converged")
+
+        ca = await AMQPClient.connect("127.0.0.1", a_amqp)
+        cha = await ca.channel()
+        await cha.confirm_select()
+        await cha.queue_declare("xp_q", durable=True)
+        # queue metadata replicates asynchronously: wait until BOTH nodes
+        # know the queue before the second client touches it
+        for _ in range(100):
+            va = await admin_cluster(a_admin)
+            vb = await admin_cluster(b_admin)
+            if va.get("known_queues") and vb.get("known_queues"):
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise RuntimeError("queue metadata never replicated to B")
+        cb = await AMQPClient.connect("127.0.0.1", b_amqp)
+        chb = await cb.channel()
+        await chb.queue_declare("xp_q", durable=True)
+
+        n = 200
+        for i in range(n):
+            cha.basic_publish(b"xp-%03d" % i, routing_key="xp_q",
+                              properties=PERSISTENT)
+        await cha.wait_unconfirmed_below(1, timeout=60)
+
+        got, done = [], asyncio.get_event_loop().create_future()
+
+        def cb_msg(m):
+            got.append(m.body)
+            chb.basic_ack(m.delivery_tag)
+            if len(got) >= n and not done.done():
+                done.set_result(None)
+
+        await chb.basic_consume("xp_q", cb_msg)
+        await asyncio.wait_for(done, 60)
+        assert got == [b"xp-%03d" % i for i in range(n)]
+        await ca.close()
+        await cb.close()
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log_file in logs:
+            log_file.close()
