@@ -39,3 +39,63 @@ def test_parser_rejects_garbage_from_header_alone():
     # not buffer gigabytes waiting for it
     out = list(FrameParser().feed(b"\x41" * 12))
     assert isinstance(out[0], FrameError)
+
+
+def test_parser_assembler_fuzz_no_crashes():
+    """Seeded fuzz over both parsers + the assembler: random garbage,
+    bit-flipped valid publishes, and truncations, fed in random chunkings.
+    Every input must end in frames, silence, or FrameError — never an
+    exception or a hang (the broker's read loop treats anything else as a
+    crash)."""
+    import random
+    import struct
+
+    from chanamq_tpu.amqp.command import CommandAssembler
+    from chanamq_tpu.amqp.frame import FrameError, FrameParser
+    from chanamq_tpu import native_ext
+
+    rng = random.Random(0xC0DEC)
+
+    def valid_publish(ch):
+        m = b"\x00\x3c\x00\x28\x00\x00\x00\x05qq\x00"
+        h = struct.pack(">HHQH", 60, 0, 4, 0x1000) + b"\x01"
+        b = b"abcd"
+        out = b""
+        for t, p in ((1, m), (2, h), (3, b)):
+            out += struct.pack(">BHI", t, ch, len(p)) + p + b"\xce"
+        return out
+
+    parser_classes = [FrameParser]
+    if native_ext.available():
+        parser_classes.append(native_ext.NativeFrameParser)
+    for trial in range(600):
+        kind = rng.randrange(3)
+        if kind == 0:
+            data = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(1, 400)))
+        elif kind == 1:
+            base = bytearray(
+                valid_publish(rng.randrange(1, 4)) * rng.randrange(1, 4))
+            for _ in range(rng.randrange(1, 6)):
+                base[rng.randrange(len(base))] = rng.randrange(256)
+            data = bytes(base)
+        else:
+            data = valid_publish(1)[:rng.randrange(1, 60)]
+        for parser_cls in parser_classes:
+            parser = parser_cls()
+            parser.frame_max = 131072
+            assembler = CommandAssembler()
+            pos = 0
+            dead = False
+            while pos < len(data) and not dead:
+                chunk = data[pos:pos + rng.randrange(1, 64)]
+                pos += len(chunk)
+                for item in parser.feed(chunk):
+                    if isinstance(item, FrameError):
+                        dead = True
+                        break
+                    if item.type in (1, 2, 3):
+                        out = assembler.feed_one(item)
+                        if isinstance(out, FrameError):
+                            dead = True
+                            break
