@@ -25,7 +25,7 @@ from ..cluster.idgen import IdGenerator
 from ..store.api import StoredExchange, StoredMessage, StoredQueue, StoreService
 from ..store.memory import MemoryStore
 from ..utils.metrics import Metrics
-from .entities import Exchange, Message, Queue, VHost, now_ms
+from .entities import Exchange, Message, Queue, VHost
 
 log = logging.getLogger("chanamq.broker")
 

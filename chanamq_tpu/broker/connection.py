@@ -37,7 +37,6 @@ from ..amqp import methods as am
 from ..amqp.properties import BasicProperties
 from .broker import Broker, BrokerError
 from .channel import ChannelMode, Consumer, ServerChannel
-from .entities import now_ms
 
 log = logging.getLogger("chanamq.connection")
 

@@ -28,7 +28,7 @@ import asyncio
 import logging
 import time
 from collections import deque
-from typing import TYPE_CHECKING, Any, Callable, Optional
+from typing import TYPE_CHECKING, Any, Optional
 
 from ..amqp.properties import BasicProperties
 from ..store.api import StoredMessage

@@ -32,10 +32,9 @@ import logging
 from typing import TYPE_CHECKING, Any, Optional
 
 from ..amqp.properties import BasicProperties
-from ..store.api import StoredQueue
 from .hashring import HashRing
-from .membership import ALIVE, Member, Membership
-from .rpc import RpcClient, RpcError, RpcServer
+from .membership import Member, Membership
+from .rpc import RpcError, RpcServer
 
 if TYPE_CHECKING:  # pragma: no cover
     from ..broker.broker import Broker

@@ -18,7 +18,7 @@ import asyncio
 import logging
 import struct
 from io import BytesIO
-from typing import Any, Awaitable, Callable, Optional
+from typing import Awaitable, Callable, Optional
 
 from ..amqp import value_codec as vc
 

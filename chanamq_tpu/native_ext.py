@@ -20,7 +20,7 @@ import os
 import subprocess
 from typing import Iterator, Optional
 
-from .amqp.constants import ErrorCode, FrameType
+from .amqp.constants import ErrorCode
 from .amqp.frame import Frame, FrameError
 from .broker.matchers import Matcher
 
