@@ -65,6 +65,16 @@ WRITE_LOW_WATERMARK = 1 * 1024 * 1024
 _PUBLISH_SIG = b"\x00\x3c\x00\x28"
 _ACK_SIG = b"\x00\x3c\x00\x50"
 
+# fused-path publish-args cache: a flow's exchange+routing-key repeat on
+# every message, so their utf-8 decodes cache keyed by the raw args slice
+# (everything past the 6 fixed bytes, bits included — plain publishes only
+# reach the fused path, so bits are always 0). Churn-driven clears disable
+# the cache for the process: per-message-unique routing keys must not pay
+# cache overhead (same adaptive pattern as the client's deliver parse).
+_PUBLISH_ARGS_CACHE: dict[bytes, tuple[str, str, bytes]] = {}
+_PUBLISH_CACHE_STRIKES = 4
+_publish_cache_strikes = 0
+
 
 class ConnectionClosed(Exception):
     pass
@@ -430,14 +440,30 @@ class AMQPConnection:
         same publish_sync call, same confirm arming — minus the Return
         cases, which the bit check routes to the fallback."""
         moff = offsets[i]
+        global _publish_cache_strikes
         payload = raw[moff:moff + lengths[i]]
-        try:
-            exchange, routing_key, bits, pos = am.parse_publish_wire(payload)
-        except (IndexError, UnicodeDecodeError, am.MethodDecodeError):
-            return 0  # truncated/bad payload: generic path raises properly
-        if bits:
-            return 0  # mandatory / immediate: generic path renders Returns
-        exrk_raw = payload[6:pos]
+        cached = None
+        caching = _publish_cache_strikes < _PUBLISH_CACHE_STRIKES
+        if caching:
+            args_key = payload[6:]
+            cached = _PUBLISH_ARGS_CACHE.get(args_key)
+        if cached is not None:
+            exchange, routing_key, exrk_raw = cached
+        else:
+            try:
+                exchange, routing_key, bits, pos = am.parse_publish_wire(payload)
+            except (IndexError, UnicodeDecodeError, am.MethodDecodeError):
+                return 0  # truncated/bad payload: generic path raises properly
+            if bits:
+                return 0  # mandatory / immediate: generic path renders Returns
+            exrk_raw = payload[6:pos]
+            if caching:
+                if len(_PUBLISH_ARGS_CACHE) >= 1024:
+                    _PUBLISH_ARGS_CACHE.clear()
+                    _publish_cache_strikes += 1
+                if _publish_cache_strikes < _PUBLISH_CACHE_STRIKES:
+                    _PUBLISH_ARGS_CACHE[args_key] = (
+                        exchange, routing_key, exrk_raw)
         channel = self.channels.get(channels[i])
         if channel is None:
             return 0  # full path raises the proper channel error
