@@ -155,3 +155,41 @@ async def test_server_stop_while_publisher_gated(tmp_path):
     await wait_for(lambda: broker.blocked)
     await asyncio.wait_for(srv.stop(), 10)  # used to hang forever
     await pub.close()
+
+
+async def test_frozen_consumer_bounds_write_buffer():
+    """Outbound backpressure (SURVEY §7.3): a consumer that stops reading
+    must cap its connection's write buffer near WRITE_HIGH_WATERMARK —
+    queue dispatch skips saturated connections and parks the backlog in
+    the queue — and drain completely once the consumer resumes."""
+    broker = Broker()
+    srv = BrokerServer(broker=broker, host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    c_cons = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    chc = await c_cons.channel()
+    await chc.queue_declare("stall_q")
+    await chc.basic_consume("stall_q", lambda m: None, no_ack=True)
+    await asyncio.sleep(0.1)
+    c_cons.reader._transport.pause_reading()  # freeze the consumer socket
+
+    c_prod = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    chp = await c_prod.channel()
+    await chp.confirm_select()
+    body = b"z" * 10_000
+    for i in range(1500):  # ~15 MB into a frozen consumer
+        chp.basic_publish(body, routing_key="stall_q")
+        if i % 500 == 499:
+            await chp.wait_unconfirmed_below(1)
+    await chp.wait_unconfirmed_below(1)
+    bufs = [len(cn._out) for cn in srv._connections]
+    queue = broker.vhosts["/"].queues["stall_q"]
+    assert max(bufs) < 6 * 1024 * 1024, f"write buffer unbounded: {bufs}"
+    assert len(queue.messages) > 0
+
+    c_cons.reader._transport.resume_reading()
+    await wait_for(
+        lambda: not queue.messages
+        and all(len(cn._out) == 0 for cn in srv._connections), timeout=30)
+    await c_prod.close()
+    await c_cons.close()
+    await srv.stop()
