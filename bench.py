@@ -48,6 +48,24 @@ SPECS = {
     "persistent_ack_3p1c": (False, True, 3, 1),
 }
 
+# the remaining BASELINE.json configs: fanout 1 producer -> 8 consumers and
+# a topic exchange with wildcard bindings over mixed routing keys (one
+# consumer per queue; delivered counts every copy, like PerfTest)
+TOPO_SPECS = {
+    "fanout_1p8c": {
+        "exchange_type": "fanout", "producers": 1,
+        "queues": [(f"bench_q{i}", [""]) for i in range(8)],
+        "keys": ["bench"],
+    },
+    "topic_3p3c_wildcards": {
+        "exchange_type": "topic", "producers": 3,
+        "queues": [("bench_q0", ["quote.*.*"]),
+                   ("bench_q1", ["quote.#", "*.eu.msft"]),
+                   ("bench_q2", ["#"])],
+        "keys": ["quote.us.appl", "quote.eu.msft", "trade.us.goog"],
+    },
+}
+
 # Paced-load latency spec: the saturated specs above measure queueing delay
 # by construction (a full confirm window IS hundreds of ms of in-flight
 # messages), so broker latency is measured separately under a fixed-rate
@@ -62,11 +80,14 @@ PACED_SPEC = "paced_latency_1p1c"
 
 
 async def producer_main(
-    port: int, persistent: bool, seconds: float, rate: int = 0
+    port: int, persistent: bool, seconds: float, rate: int = 0,
+    keys: "list[str] | None" = None,
 ) -> None:
     from chanamq_tpu.amqp.properties import BasicProperties
     from chanamq_tpu.client import AMQPClient
 
+    keys = keys or ["bench"]
+    nkeys = len(keys)
     c = await AMQPClient.connect("127.0.0.1", port)
     ch = await c.channel()
     await ch.confirm_select()
@@ -82,7 +103,8 @@ async def producer_main(
             for _ in range(burst):
                 body = time.time_ns().to_bytes(8, "big") + pad
                 ch.basic_publish(body, exchange="bench_ex",
-                                 routing_key="bench", properties=props)
+                                 routing_key=keys[published % nkeys],
+                                 properties=props)
                 published += 1
             next_t += burst / rate
             delay = next_t - time.perf_counter()
@@ -95,7 +117,8 @@ async def producer_main(
     else:
         while time.perf_counter() < deadline:
             body = time.time_ns().to_bytes(8, "big") + pad
-            ch.basic_publish(body, exchange="bench_ex", routing_key="bench",
+            ch.basic_publish(body, exchange="bench_ex",
+                             routing_key=keys[published % nkeys],
                              properties=props)
             published += 1
             if len(ch.unconfirmed) >= CONFIRM_WINDOW:
@@ -110,7 +133,8 @@ async def producer_main(
     print(json.dumps({"role": "producer", "published": published}), flush=True)
 
 
-async def consumer_main(port: int, auto_ack: bool, seconds: float) -> None:
+async def consumer_main(port: int, auto_ack: bool, seconds: float,
+                        queue: str = "bench_q") -> None:
     from chanamq_tpu.client import AMQPClient
 
     c = await AMQPClient.connect("127.0.0.1", port)
@@ -127,7 +151,7 @@ async def consumer_main(port: int, auto_ack: bool, seconds: float) -> None:
         if not auto_ack and delivered % 500 == 0:
             ch.basic_ack(msg.delivery_tag, multiple=True)
 
-    await ch.basic_consume("bench_q", on_msg, no_ack=auto_ack)
+    await ch.basic_consume(queue, on_msg, no_ack=auto_ack)
     # run until producers are done plus drain time
     await asyncio.sleep(seconds + 3)
     if not auto_ack and delivered:
@@ -170,14 +194,20 @@ def free_port() -> int:
     return port
 
 
-async def setup_topology(port: int, persistent: bool) -> None:
+async def setup_topology(
+    port: int, persistent: bool, exchange_type: str = "direct",
+    queues: "list[tuple[str, list[str]]] | None" = None,
+) -> None:
     from chanamq_tpu.client import AMQPClient
 
+    queues = queues or [("bench_q", ["bench"])]
     c = await AMQPClient.connect("127.0.0.1", port)
     ch = await c.channel()
-    await ch.exchange_declare("bench_ex", "direct", durable=persistent)
-    await ch.queue_declare("bench_q", durable=persistent)
-    await ch.queue_bind("bench_q", "bench_ex", "bench")
+    await ch.exchange_declare("bench_ex", exchange_type, durable=persistent)
+    for name, bind_keys in queues:
+        await ch.queue_declare(name, durable=persistent)
+        for key in bind_keys:
+            await ch.queue_bind(name, "bench_ex", key)
     await c.close()
 
 
@@ -193,8 +223,20 @@ def _tail(path: str, limit: int = 2000) -> str:
 
 
 def run_spec(name: str, rate: int = 0) -> dict:
+    persistent = False
+    exchange_type = "direct"
+    queues = None  # default bench_q/bench
+    keys = None
     if name == PACED_SPEC:
-        auto_ack, persistent, producers, consumers = True, False, 1, 1
+        auto_ack, producers, consumers = True, 1, 1
+    elif name in TOPO_SPECS:
+        topo = TOPO_SPECS[name]
+        auto_ack = True
+        producers = topo["producers"]
+        exchange_type = topo["exchange_type"]
+        queues = topo["queues"]
+        keys = topo["keys"]
+        consumers = len(queues)
     else:
         auto_ack, persistent, producers, consumers = SPECS[name]
     port = free_port()
@@ -220,20 +262,26 @@ def run_spec(name: str, rate: int = 0) -> dict:
     elapsed = 0.0
     try:
         wait_port(port)
-        asyncio.run(setup_topology(port, persistent))
-        for _ in range(consumers):
+        asyncio.run(setup_topology(port, persistent, exchange_type, queues))
+        queue_names = [q for q, _ in queues] if queues else ["bench_q"]
+        for i in range(consumers):
             children.append(subprocess.Popen(
                 [sys.executable, __file__, "--role", "consumer",
                  "--port", str(port), "--auto-ack", str(int(auto_ack)),
-                 "--seconds", str(BENCH_SECONDS)],
+                 "--seconds", str(BENCH_SECONDS),
+                 "--queue", queue_names[i % len(queue_names)]],
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
         time.sleep(0.3)
         t0 = time.perf_counter()
+        producer_args = []
+        if keys:
+            producer_args = ["--keys", ",".join(keys)]
         for _ in range(producers):
             children.append(subprocess.Popen(
                 [sys.executable, __file__, "--role", "producer",
                  "--port", str(port), "--persistent", str(int(persistent)),
-                 "--seconds", str(BENCH_SECONDS), "--rate", str(rate)],
+                 "--seconds", str(BENCH_SECONDS), "--rate", str(rate)]
+                + producer_args,
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
         for i, child in enumerate(children):
             role = "consumer" if i < consumers else "producer"
@@ -416,25 +464,31 @@ def main() -> None:
         parser.add_argument("--persistent", type=int, default=0)
         parser.add_argument("--seconds", type=float, default=5)
         parser.add_argument("--rate", type=int, default=0)
+        parser.add_argument("--queue", default="bench_q")
+        parser.add_argument("--keys", default="")
         args = parser.parse_args()
         if args.role == "producer":
+            keys = [k for k in args.keys.split(",") if k] or None
             asyncio.run(producer_main(
-                args.port, bool(args.persistent), args.seconds, args.rate))
+                args.port, bool(args.persistent), args.seconds, args.rate,
+                keys))
         else:
-            asyncio.run(consumer_main(args.port, bool(args.auto_ack), args.seconds))
+            asyncio.run(consumer_main(
+                args.port, bool(args.auto_ack), args.seconds, args.queue))
         return
 
     which = os.environ.get("BENCH_SPECS", "all")
     if which == "a":
         names = ["transient_autoack_3p3c"]
     elif which == "all":
-        names = list(SPECS)
+        names = list(SPECS) + list(TOPO_SPECS)
     else:
-        names = [n.strip() for n in which.split(",") if n.strip() in SPECS]
+        names = [n.strip() for n in which.split(",")
+                 if n.strip() in SPECS or n.strip() in TOPO_SPECS]
         if not names:
             print(f"# BENCH_SPECS={which!r} matched no spec; running all",
                   file=sys.stderr)
-            names = list(SPECS)
+            names = list(SPECS) + list(TOPO_SPECS)
     results = {}
     for name in names:
         results[name] = run_spec(name)
@@ -442,9 +496,12 @@ def main() -> None:
     headline = results[names[0]]
     if which != "a":
         # paced latency run at ~25% of the measured headline throughput
+        # derive from PUBLISHED (not delivered) throughput: a fan-out
+        # headline's delivered rate counts every copy and would oversaturate
+        # the 1p1c paced spec that exists to measure latency below capacity
         paced_rate = int(os.environ.get(
             "BENCH_PACED_RATE",
-            max(1000, int(headline.get("delivered_per_s", 0) * 0.25))))
+            max(1000, int(headline.get("published_per_s", 0) * 0.25))))
         results[PACED_SPEC] = run_spec(PACED_SPEC, rate=paced_rate)
         results[PACED_SPEC]["rate"] = paced_rate
         print(f"# {PACED_SPEC}: {results[PACED_SPEC]}", file=sys.stderr)
