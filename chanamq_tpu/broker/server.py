@@ -151,7 +151,9 @@ class BrokerServer:
         if store_path:
             from ..store.sqlite import SqliteStore
 
-            store = SqliteStore(store_path)
+            store = SqliteStore(
+                store_path,
+                synchronous=config.str("chana.mq.store.synchronous"))
         ssl_context = None
         tls_port = None
         if config.bool("chana.mq.amqp.amqps.enabled"):

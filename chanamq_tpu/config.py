@@ -68,6 +68,10 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.admin.port": 15672,
     "chana.mq.vhost.default": "/",
     "chana.mq.store.path": None,
+    # sqlite PRAGMA synchronous: NORMAL survives process crashes (WAL
+    # replay); FULL additionally fsyncs every group commit so confirmed
+    # messages survive power loss, at a persistent-throughput cost
+    "chana.mq.store.synchronous": "NORMAL",
     "chana.mq.cluster.enabled": False,
     "chana.mq.cluster.host": "127.0.0.1",
     "chana.mq.cluster.port": 25672,

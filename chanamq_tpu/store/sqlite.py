@@ -90,8 +90,20 @@ CREATE TABLE IF NOT EXISTS queue_unacks_deleted (
 
 
 class SqliteStore(StoreService):
-    def __init__(self, path: str = ":memory:") -> None:
+    def __init__(self, path: str = ":memory:",
+                 synchronous: str = "NORMAL") -> None:
         self.path = path
+        # durability tier (PRAGMA synchronous, config
+        # chana.mq.store.synchronous): NORMAL (default) survives process
+        # crashes — a COMMIT is in the OS page cache and the WAL replays
+        # after SIGKILL — but a POWER loss can roll back recently-committed
+        # transactions (confirms included). FULL fsyncs every group commit:
+        # power-loss durable, at a large cost to persistent throughput.
+        # The reference inherited whatever its Cassandra cluster was
+        # configured for; here the knob is explicit.
+        if synchronous.upper() not in ("OFF", "NORMAL", "FULL", "EXTRA"):
+            raise ValueError(f"bad synchronous level {synchronous!r}")
+        self.synchronous = synchronous.upper()
         self._db: Optional[sqlite3.Connection] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         # single writer thread => strict FIFO op ordering
@@ -372,7 +384,7 @@ class SqliteStore(StoreService):
             db = sqlite3.connect(
                 self.path, check_same_thread=False, isolation_level=None)
             db.execute("PRAGMA journal_mode=WAL")
-            db.execute("PRAGMA synchronous=NORMAL")
+            db.execute(f"PRAGMA synchronous={self.synchronous}")
             db.execute("PRAGMA busy_timeout=10000")
             db.executescript(_SCHEMA)
             return db

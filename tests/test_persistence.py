@@ -483,3 +483,28 @@ async def test_memory_store_writes_apply_at_call_time():
     del_aw = store.delete_message(5)
     assert await store.select_message(5) is None
     await del_aw
+
+
+async def test_store_synchronous_knob(tmp_path):
+    """chana.mq.store.synchronous plumbs through config to the PRAGMA:
+    FULL fsyncs every group commit (power-loss durability), NORMAL is the
+    WAL default (process-crash durability). Bad values fail fast."""
+    from chanamq_tpu.config import Config
+    from chanamq_tpu.broker.server import BrokerServer
+
+    cfg = Config({
+        "chana.mq.store.path": str(tmp_path / "full.db"),
+        "chana.mq.store.synchronous": "FULL",
+        "chana.mq.amqp.port": 0,
+    })
+    srv = BrokerServer.from_config(cfg)
+    await srv.start()
+    assert srv.broker.store.synchronous == "FULL"
+    # PRAGMA actually applied on the open connection (2 == FULL)
+    level = await srv.broker.store._submit(
+        lambda db: db.execute("PRAGMA synchronous").fetchone()[0])
+    assert level == 2, level
+    await srv.stop()
+
+    with pytest.raises(ValueError):
+        SqliteStore(str(tmp_path / "bad.db"), synchronous="SOMETIMES")
