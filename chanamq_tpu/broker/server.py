@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import ssl
 from typing import Optional
 
@@ -196,13 +197,33 @@ class BrokerServer:
 
 async def run_node(config) -> None:
     """Boot a full node: broker + AMQP(+AMQPS) listeners + admin REST
-    (the reference's AMQPServer.main composition, AMQPServer.scala:39-111)."""
+    (the reference's AMQPServer.main composition, AMQPServer.scala:39-111).
+    SIGTERM/SIGINT trigger a graceful drain: listeners close, live
+    connections tear down (unacked requeue, store buffers flush), the
+    group-commit queue drains, then the process exits 0 — the analogue of
+    the reference's JVM shutdown hooks."""
+    import signal as signal_module
+
     from ..rest.admin import AdminServer
 
     server = BrokerServer.from_config(config)
     admin = None
     cluster = None
     started = False
+    stop_event = asyncio.Event()
+    loop = asyncio.get_running_loop()
+
+    def on_signal() -> None:
+        if stop_event.is_set():
+            # second signal while draining: the operator wants OUT now
+            os._exit(130)
+        stop_event.set()
+
+    for sig in (signal_module.SIGTERM, signal_module.SIGINT):
+        try:
+            loop.add_signal_handler(sig, on_signal)
+        except (NotImplementedError, RuntimeError, ValueError):  # pragma: no cover
+            pass  # non-unix platform or non-main thread: KeyboardInterrupt
     try:
         # boot order matters: broker state, then the cluster layer, then
         # the AMQP listeners — a client accepted before the cluster is live
@@ -224,6 +245,10 @@ async def run_node(config) -> None:
                     "chana.mq.cluster.failure-timeout") or 5.0,
             )
             await cluster.start()
+        if stop_event.is_set():
+            # signalled during boot (e.g. while the cluster joined its
+            # seeds): don't open listeners just to tear clients down
+            return
         await server.start_listeners()
         if config.bool("chana.mq.admin.enabled"):
             admin = AdminServer(
@@ -232,7 +257,8 @@ async def run_node(config) -> None:
                 port=config.int("chana.mq.admin.port"),
             )
             await admin.start()
-        await asyncio.Event().wait()
+        await stop_event.wait()
+        log.info("shutdown signal received; draining")
     finally:
         if admin:
             await admin.stop()
