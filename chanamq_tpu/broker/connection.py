@@ -14,6 +14,14 @@ task drains an explicit output buffer (the reference's subtle isLastCommand
 batching becomes trivially correct — everything appended between drains
 coalesces into one TCP write). Delivery pushes come from queue dispatch
 (event-driven), never from a poll tick.
+
+Hot loop (_consume_scan): the native scanner hands back frame-index arrays
+for a whole read chunk; contained Basic.Publish triples and Basic.Ack
+frames are handled straight off the arrays with no Frame/Method/AMQCommand
+objects (_fused_publish/_fused_ack), and everything else falls back to the
+per-frame assembler path. Batch boundaries double as barriers: publisher
+confirms, the store group-commit flush, and pipelined remote queue.push
+RPCs all settle once per read batch (_confirm_barrier).
 """
 
 from __future__ import annotations
