@@ -359,11 +359,15 @@ class ClusterNode:
         return await self.membership.client(node).call(method, payload)
 
     async def _event(self, node: str, method: str, payload: dict) -> None:
+        """Fire-and-forget event toward a peer. Loss is part of the design
+        contract (deliveries: unacked copies requeue via failure detection;
+        no_ack is at-most-once; credit: replenished on the next settle) —
+        but log it for the operator chasing a partition."""
         assert self.membership is not None
         try:
             await self.membership.client(node).send_event(method, payload)
-        except (RpcError, OSError):
-            pass
+        except (RpcError, OSError) as exc:
+            log.debug("event %s to %s dropped: %r", method, node, exc)
 
     async def broadcast(self, method: str, payload: dict) -> None:
         assert self.membership is not None
