@@ -184,10 +184,12 @@ class RpcClient:
             reader, writer = await asyncio.open_connection(self.host, self.port)
             self._writer = writer
             self._reader_task = asyncio.get_event_loop().create_task(
-                self._read_loop(reader))
+                self._read_loop(reader, writer))
             return writer
 
-    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+    async def _read_loop(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
         try:
             while True:
                 corr_id, kind, _method, payload = await _read_frame(reader)
@@ -204,7 +206,14 @@ class RpcClient:
             pass
         finally:
             self._fail_waiters(RpcError("disconnected", f"{self.host}:{self.port}"))
-            self._writer = None
+            # close OUR writer (dead peer), not whatever reconnect may have
+            # installed since; abandoning it would leak the socket until GC
+            if self._writer is writer:
+                self._writer = None
+            try:
+                writer.close()
+            except Exception:
+                pass
 
     def _fail_waiters(self, exc: Exception) -> None:
         for fut in self._waiters.values():
