@@ -246,3 +246,53 @@ async def test_interleaved_channel_content_frames(client):
             break
         await asyncio.sleep(0.02)
     assert sorted(got) == [b"from-ch1", b"from-ch2"]
+
+
+async def test_tiny_negotiated_frame_max_round_trip():
+    """frame_max=4096 (near the spec minimum): every large body splits
+    into dozens of frames in both directions; reassembly must be exact
+    for varied sizes including one spanning ~25 frames."""
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                       frame_max=4096)
+    await srv.start()
+    c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    assert c.frame_max == 4096
+    ch = await c.channel()
+    await ch.confirm_select()
+    await ch.queue_declare("frag_q")
+    bodies = [bytes([i % 256]) * (4000 + i * 997) for i in range(12)]
+    bodies.append(bytes(range(256)) * 400)  # 102400 bytes
+    got, done = [], asyncio.get_event_loop().create_future()
+
+    def cb(m):
+        got.append(m.body)
+        ch.basic_ack(m.delivery_tag)
+        if len(got) >= len(bodies) and not done.done():
+            done.set_result(None)
+
+    await ch.basic_consume("frag_q", cb)
+    for body in bodies:
+        ch.basic_publish(body, routing_key="frag_q")
+    await ch.wait_unconfirmed_below(1)
+    await asyncio.wait_for(done, 30)
+    assert got == bodies
+    await c.close()
+    await srv.stop()
+
+
+async def test_channel_max_enforced():
+    """Opening more channels than the negotiated channel-max is refused
+    with a connection error; existing channels keep working."""
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                       channel_max=4)
+    await srv.start()
+    c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    chans = [await c.channel() for _ in range(4)]
+    with pytest.raises(Exception):
+        await c.channel()
+    await chans[0].queue_declare("cm_q")
+    chans[0].basic_publish(b"ok", routing_key="cm_q")
+    m = await chans[0].basic_get("cm_q", no_ack=True)
+    assert m is not None and m.body == b"ok"
+    await c.close()
+    await srv.stop()
