@@ -437,3 +437,28 @@ async def test_publish_cache_detects_props_mutation(client):
     assert m1.properties.correlation_id == "a"
     assert m2.properties.delivery_mode == 2
     assert m2.properties.correlation_id == "b"
+
+
+async def test_vhost_isolation(server):
+    """Same-named queues and exchanges in different vhosts are fully
+    separate (reference: VirtualHost model + entity ids prefixed with the
+    vhost, VhostEntity.scala:20-131)."""
+    await server.broker.create_vhost("tenant")
+    ca = await AMQPClient.connect("127.0.0.1", server.bound_port)
+    cb = await AMQPClient.connect("127.0.0.1", server.bound_port,
+                                  vhost="tenant")
+    cha, chb = await ca.channel(), await cb.channel()
+    await cha.queue_declare("iso_q")
+    await chb.queue_declare("iso_q")
+    cha.basic_publish(b"for-root", routing_key="iso_q")
+    chb.basic_publish(b"for-tenant", routing_key="iso_q")
+    await asyncio.sleep(0.1)
+    assert (await cha.basic_get("iso_q", no_ack=True)).body == b"for-root"
+    assert (await chb.basic_get("iso_q", no_ack=True)).body == b"for-tenant"
+    assert await cha.basic_get("iso_q", no_ack=True) is None
+    assert await chb.basic_get("iso_q", no_ack=True) is None
+    await cha.exchange_declare("iso_ex", "fanout")
+    with pytest.raises(Exception):
+        await chb.exchange_declare("iso_ex", "fanout", passive=True)
+    await ca.close()
+    await cb.close()
