@@ -173,3 +173,58 @@ def test_matcher_factory():
     else:
         assert isinstance(topic, TopicMatcher)
     assert isinstance(matcher_for("headers"), HeadersMatcher)
+
+
+def test_topic_matchers_agree_randomized():
+    """Seeded property test: the Python TopicMatcher, the native C++ trie,
+    and a brute-force reference evaluator must agree on every (pattern
+    set, routing key) pair across random topologies — including `*`/`#`
+    in every position, empty words, and bind/unbind churn."""
+    import random
+
+    from chanamq_tpu import native_ext
+    from chanamq_tpu.broker.matchers import TopicMatcher
+
+    def naive_match(pattern: str, key: str) -> bool:
+        # textbook recursive AMQP topic match over '.'-split words
+        def rec(p, k):
+            if not p:
+                return not k
+            if p[0] == "#":
+                return any(rec(p[1:], k[i:]) for i in range(len(k) + 1))
+            if not k:
+                return False
+            if p[0] == "*" or p[0] == k[0]:
+                return rec(p[1:], k[1:])
+            return False
+        return rec(pattern.split("."), key.split("."))
+
+    rng = random.Random(0x70C1C)
+    words = ["a", "b", "cc", "*", "#"]
+    key_words = ["a", "b", "cc", "d"]
+    matchers = [TopicMatcher()]
+    if native_ext.available():
+        matchers.append(native_ext.NativeTopicMatcher())
+    bound: set[tuple[str, str]] = set()
+    for trial in range(400):
+        op = rng.random()
+        if op < 0.5 or not bound:
+            pattern = ".".join(rng.choice(words)
+                               for _ in range(rng.randrange(1, 5)))
+            queue = f"q{rng.randrange(6)}"
+            for m in matchers:
+                m.bind(pattern, queue)
+            bound.add((pattern, queue))
+        elif op < 0.65:
+            pattern, queue = rng.choice(sorted(bound))
+            for m in matchers:
+                m.unbind(pattern, queue)
+            bound.discard((pattern, queue))
+        key = ".".join(rng.choice(key_words)
+                       for _ in range(rng.randrange(1, 5)))
+        expected = {q for (p, q) in bound if naive_match(p, key)}
+        for m in matchers:
+            got = m.route(key)
+            assert got == expected, (
+                f"{type(m).__name__} diverged on key={key!r}: "
+                f"{got} != {expected}; bound={sorted(bound)}")
