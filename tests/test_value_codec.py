@@ -98,3 +98,44 @@ def test_unknown_tag_raises():
     data = len(body).to_bytes(4, "big") + body
     with pytest.raises(vc.CodecError):
         vc.decode_table(data)
+
+
+def test_roundtrip_randomized_nested():
+    """Seeded fuzz: random deeply-nested tables/arrays of every supported
+    value shape must round-trip exactly (the cluster RPC layer ships
+    arbitrary payloads through this codec — queue.push_many batches carry
+    lists of tables with bytes values)."""
+    import random
+    from io import BytesIO
+
+    rng = random.Random(0xF1E1D)
+
+    def rand_value(depth):
+        kinds = ["int", "str", "bytes", "bool", "none", "float"]
+        if depth < 3:
+            kinds += ["table", "array"]
+        kind = rng.choice(kinds)
+        if kind == "int":
+            return rng.randrange(-2**40, 2**40)
+        if kind == "str":
+            return "".join(rng.choice("abčé.💬x") for _ in range(rng.randrange(6)))
+        if kind == "bytes":
+            return bytes(rng.randrange(256) for _ in range(rng.randrange(8)))
+        if kind == "bool":
+            return rng.random() < 0.5
+        if kind == "none":
+            return None
+        if kind == "float":
+            return rng.randrange(-1000, 1000) / 8  # exact in binary
+        if kind == "table":
+            return {f"k{i}": rand_value(depth + 1)
+                    for i in range(rng.randrange(4))}
+        return [rand_value(depth + 1) for i in range(rng.randrange(4))]
+
+    for trial in range(200):
+        table = {f"key{i}": rand_value(0) for i in range(rng.randrange(6))}
+        out = BytesIO()
+        vc.write_table(out, table)
+        back = vc.read_table(BytesIO(out.getvalue()))
+        # bytes values come back as bytes; str as str — exact equality
+        assert back == table, (trial, table, back)
