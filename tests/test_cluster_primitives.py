@@ -226,3 +226,36 @@ async def test_membership_rejoin_after_down():
     finally:
         await m1.stop()
         await s1.stop()
+
+
+def test_ring_balance_across_nodes():
+    """Consistent-hash distribution: with the default virtual-node count,
+    no node owns a pathological share of keys (the reference sharded by
+    entityId.hashCode % 100; this ring must spread at least as well)."""
+    ring = HashRing(["node-a", "node-b", "node-c"], virtual_nodes=64)
+    counts = {"node-a": 0, "node-b": 0, "node-c": 0}
+    n = 9000
+    for i in range(n):
+        counts[ring.owner_entity("q", "/", f"queue-{i}")] += 1
+    for node, count in counts.items():
+        share = count / n
+        assert 0.15 < share < 0.55, (node, share, counts)
+
+
+def test_ring_minimal_movement_on_join():
+    """Adding a node must move only the keys the new node takes over —
+    ownership of everything else is pinned (the join-churn guarantee the
+    broker's queue routing relies on)."""
+    before = HashRing(["node-a", "node-b"], virtual_nodes=64)
+    after = HashRing(["node-a", "node-b", "node-c"], virtual_nodes=64)
+    moved = stayed = 0
+    for i in range(4000):
+        o1 = before.owner_entity("q", "/", f"queue-{i}")
+        o2 = after.owner_entity("q", "/", f"queue-{i}")
+        if o1 == o2:
+            stayed += 1
+        else:
+            moved += 1
+            assert o2 == "node-c", (o1, o2)  # keys only move TO the joiner
+    # roughly a third moves; anything far beyond that breaks the pin
+    assert moved / 4000 < 0.5, moved
