@@ -228,3 +228,55 @@ def test_topic_matchers_agree_randomized():
             assert got == expected, (
                 f"{type(m).__name__} diverged on key={key!r}: "
                 f"{got} != {expected}; bound={sorted(bound)}")
+
+
+def test_headers_matcher_agrees_with_naive_model():
+    """Seeded property test: the inverted-index HeadersMatcher must agree
+    with a brute-force evaluator across random binding sets (x-match all
+    and any, overlapping keys, absent headers, bind/unbind churn)."""
+    import random
+
+    from chanamq_tpu.broker.matchers import HeadersMatcher
+
+    def naive_route(bindings, headers):
+        out = set()
+        headers = headers or {}
+        for args, queue in bindings:
+            pairs = {k: v for k, v in args.items() if not k.startswith("x-")}
+            if not pairs:
+                continue
+            if args.get("x-match") == "any":
+                ok = any(headers.get(k) == v for k, v in pairs.items())
+            else:  # all (default)
+                ok = all(headers.get(k) == v for k, v in pairs.items())
+            if ok:
+                out.add(queue)
+        return out
+
+    rng = random.Random(0x4EAD)
+    keys = ["fmt", "region", "tier"]
+    vals = ["a", "b", 1, 2]
+    matcher = HeadersMatcher()
+    bound: list[tuple[dict, str]] = []
+    for trial in range(300):
+        if rng.random() < 0.5 or not bound:
+            args = {k: rng.choice(vals)
+                    for k in rng.sample(keys, rng.randrange(1, 3))}
+            if rng.random() < 0.5:
+                args["x-match"] = rng.choice(["all", "any"])
+            queue = f"q{rng.randrange(5)}"
+            # HeadersMatcher dedupes on (args, queue); mirror that
+            if not any(a == args and q == queue for a, q in bound):
+                matcher.bind("", queue, args)
+                bound.append((dict(args), queue))
+        elif rng.random() < 0.3:
+            args, queue = bound.pop(rng.randrange(len(bound)))
+            matcher.unbind("", queue, args)
+        headers = {k: rng.choice(vals)
+                   for k in rng.sample(keys, rng.randrange(0, 4))}
+        if rng.random() < 0.1:
+            headers = None
+        expected = naive_route(bound, headers)
+        got = matcher.route("ignored", headers)
+        assert got == expected, (trial, headers, sorted(
+            (a, q) for a, q in bound), got, expected)
