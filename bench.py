@@ -72,6 +72,7 @@ TOPO_SPECS = {
 # load well below capacity. The rate is derived from the measured headline
 # (~25% of saturated throughput) or BENCH_PACED_RATE.
 PACED_SPEC = "paced_latency_1p1c"
+PACED_PERSISTENT_SPEC = "paced_persistent_latency_1p1c"
 
 
 # ---------------------------------------------------------------------------
@@ -229,6 +230,11 @@ def run_spec(name: str, rate: int = 0) -> dict:
     keys = None
     if name == PACED_SPEC:
         auto_ack, producers, consumers = True, 1, 1
+    elif name == PACED_PERSISTENT_SPEC:
+        # durable-path latency: publish->deliver through the group-commit
+        # store at a rate well below persistent capacity
+        auto_ack, producers, consumers = True, 1, 1
+        persistent = True
     elif name in TOPO_SPECS:
         topo = TOPO_SPECS[name]
         auto_ack = True
@@ -495,16 +501,25 @@ def main() -> None:
         print(f"# {name}: {results[name]}", file=sys.stderr)
     headline = results[names[0]]
     if which != "a":
-        # paced latency run at ~25% of the measured headline throughput
-        # derive from PUBLISHED (not delivered) throughput: a fan-out
-        # headline's delivered rate counts every copy and would oversaturate
-        # the 1p1c paced spec that exists to measure latency below capacity
-        paced_rate = int(os.environ.get(
-            "BENCH_PACED_RATE",
-            max(1000, int(headline.get("published_per_s", 0) * 0.25))))
-        results[PACED_SPEC] = run_spec(PACED_SPEC, rate=paced_rate)
-        results[PACED_SPEC]["rate"] = paced_rate
-        print(f"# {PACED_SPEC}: {results[PACED_SPEC]}", file=sys.stderr)
+        # paced latency runs at ~25% of the measured PUBLISHED throughput
+        # (not delivered: a fan-out headline's delivered rate counts every
+        # copy and would oversaturate the 1p1c spec), or the env override
+        for paced_name, env_key, base in (
+                (PACED_SPEC, "BENCH_PACED_RATE", headline),
+                (PACED_PERSISTENT_SPEC, "BENCH_PACED_PERSISTENT_RATE",
+                 results.get("persistent_autoack_3p1c", {}))):
+            rate_env = os.environ.get(env_key)
+            if rate_env is not None:
+                rate = int(rate_env)
+            elif base.get("published_per_s"):
+                rate = max(1000, int(base["published_per_s"] * 0.25))
+            else:
+                print(f"# {paced_name}: skipped (no base throughput and "
+                      f"no {env_key})", file=sys.stderr)
+                continue
+            results[paced_name] = run_spec(paced_name, rate=rate)
+            results[paced_name]["rate"] = rate
+            print(f"# {paced_name}: {results[paced_name]}", file=sys.stderr)
     cluster = None
     if which == "all":
         cluster = run_cluster_spec()
@@ -517,6 +532,8 @@ def main() -> None:
         "p99_publish_to_deliver_us": headline.get("p99_us"),
         "paced_p50_us": results.get(PACED_SPEC, {}).get("p50_us"),
         "paced_p99_us": results.get(PACED_SPEC, {}).get("p99_us"),
+        "paced_persistent_p99_us":
+            results.get(PACED_PERSISTENT_SPEC, {}).get("p99_us"),
         "body_bytes": BODY_BYTES,
         "seconds": BENCH_SECONDS,
         "specs": results,
