@@ -619,3 +619,65 @@ async def test_two_process_cluster_end_to_end(tmp_path):
                 p.kill()
         for log_file in logs:
             log_file.close()
+
+
+async def test_origin_death_requeues_outstanding(tmp_path):
+    """A remote consumer's ORIGIN node dies with deliveries unacked: the
+    owner's membership down-event must requeue them
+    (ClusterNode._drop_origin_consumers) so a consumer elsewhere gets
+    every message — nothing stays stuck outstanding."""
+    nodes = await start_cluster(tmp_path, 3)
+    try:
+        owner, _ = owner_and_other(nodes, "/", "org_q")
+        origin = next(n for n in nodes if n.name != owner.name)
+        third = next(n for n in nodes
+                     if n.name not in (owner.name, origin.name))
+
+        c_prod = await AMQPClient.connect("127.0.0.1", owner.port)
+        chp = await c_prod.channel()
+        await chp.confirm_select()
+        await chp.queue_declare("org_q", durable=True)
+        c_cons = await AMQPClient.connect("127.0.0.1", origin.port)
+        chc = await c_cons.channel()
+        got = []
+        await chc.basic_consume("org_q", lambda m: got.append(m))  # no acks
+        for i in range(12):
+            chp.basic_publish(b"o-%02d" % i, routing_key="org_q",
+                              properties=PERSISTENT)
+        await chp.wait_unconfirmed_below(1)
+        for _ in range(100):
+            if len(got) >= 12:
+                break
+            await asyncio.sleep(0.05)
+        assert len(got) == 12  # all delivered to the doomed origin, unacked
+
+        await origin.stop()  # origin dies with everything outstanding
+        q = owner.server.broker.vhosts["/"].queues["org_q"]
+        for _ in range(200):
+            if not q.outstanding and len(q.messages) == 12:
+                break
+            await asyncio.sleep(0.05)
+        assert not q.outstanding
+        assert len(q.messages) == 12  # requeued, redelivery-ready
+
+        c2 = await AMQPClient.connect("127.0.0.1", third.port)
+        ch2 = await c2.channel()
+        got2, done = [], asyncio.get_event_loop().create_future()
+
+        def cb(m):
+            got2.append(m.body)
+            ch2.basic_ack(m.delivery_tag)
+            if len(got2) >= 12 and not done.done():
+                done.set_result(None)
+
+        await ch2.basic_consume("org_q", cb)
+        await asyncio.wait_for(done, 30)
+        assert sorted(got2) == [b"o-%02d" % i for i in range(12)]
+        await c_prod.close()
+        await c2.close()
+    finally:
+        for node in nodes:
+            try:
+                await node.stop()
+            except Exception:
+                pass
