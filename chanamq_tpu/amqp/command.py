@@ -58,13 +58,27 @@ class CommandAssembler:
 
     Feed it complete frames; it yields `AMQCommand` or `FrameError`.
     Heartbeat frames are not handled here — filter them before feeding.
-    """
 
-    __slots__ = ("_partial",)
+    max_body_size (0 = unlimited) bounds the declared content size: body
+    chunks accumulate here until the declared size arrives, so without a
+    cap a peer declaring a huge body could grow broker RAM without limit
+    (the reference's FrameParser carried the same guard as its
+    message-size limit, FrameParser.scala:67-158). The AGGREGATE declared
+    size across all channels is additionally bounded at 4x the per-message
+    cap: without it, a connection could park one near-cap partial on every
+    channel (channel-max of them) and hold cap x channels of RAM invisible
+    to the broker's memory gauge."""
 
-    def __init__(self) -> None:
+    __slots__ = ("_partial", "max_body_size", "_declared_bytes")
+
+    def __init__(self, max_body_size: int = 0) -> None:
         # channel id -> in-flight (command, expected_body_size, received_size)
         self._partial: dict[int, _Partial] = {}
+        self.max_body_size = max_body_size
+        # sum of expected_size over in-flight partials (declared-size
+        # accounting: chunks can never exceed declared + one frame, so
+        # bounding declarations bounds memory at message granularity)
+        self._declared_bytes = 0
 
     def feed_one(self, frame: Frame) -> "AMQCommand | FrameError | None":
         """Feed one frame; returns the completed command, a protocol error,
@@ -99,6 +113,7 @@ class CommandAssembler:
             partial.received += len(frame.payload)
             if partial.received > partial.expected_size:
                 del self._partial[channel]
+                self._declared_bytes -= partial.expected_size
                 return FrameError(
                     ErrorCode.FRAME_ERROR,
                     f"body overflows declared size on channel {channel}",
@@ -106,6 +121,7 @@ class CommandAssembler:
             if partial.received == partial.expected_size:
                 partial.command.body = b"".join(partial.chunks)
                 del self._partial[channel]
+                self._declared_bytes -= partial.expected_size
                 return partial.command
             return None
         elif frame.type == FrameType.HEADER:
@@ -118,12 +134,27 @@ class CommandAssembler:
                 _class_id, body_size, props = BasicProperties.decode_header(frame.payload)
             except Exception as exc:
                 return FrameError(ErrorCode.SYNTAX_ERROR, f"bad content header: {exc}")
+            if self.max_body_size and body_size > self.max_body_size:
+                del self._partial[channel]
+                return FrameError(
+                    ErrorCode.FRAME_ERROR,
+                    f"declared body size {body_size} exceeds max message "
+                    f"size {self.max_body_size}")
+            if self.max_body_size and (self._declared_bytes + body_size
+                                       > 4 * self.max_body_size):
+                del self._partial[channel]
+                return FrameError(
+                    ErrorCode.FRAME_ERROR,
+                    f"aggregate in-flight content "
+                    f"{self._declared_bytes + body_size} exceeds "
+                    f"{4 * self.max_body_size}")
             partial.command.properties = props
             partial.command.header_raw = frame.payload
             partial.expected_size = body_size
             if body_size == 0:
                 del self._partial[channel]
                 return partial.command
+            self._declared_bytes += body_size
             return None
         else:
             return FrameError(ErrorCode.UNEXPECTED_FRAME, f"frame type {frame.type}")
@@ -135,7 +166,9 @@ class CommandAssembler:
 
     def abort_channel(self, channel: int) -> None:
         """Drop any in-flight content on a channel (e.g. on channel close)."""
-        self._partial.pop(channel, None)
+        partial = self._partial.pop(channel, None)
+        if partial is not None and partial.expected_size:
+            self._declared_bytes -= partial.expected_size
 
 
 @dataclass(slots=True)

@@ -115,6 +115,7 @@ class AMQPConnection:
         heartbeat_s: int = 30,
         frame_max: int = 131072,
         channel_max: int = 2047,
+        max_message_size: int = 128 * 1024 * 1024,
     ) -> None:
         self.broker = broker
         self.reader = reader
@@ -144,7 +145,11 @@ class AMQPConnection:
             self._parser: FrameParser = native_ext.NativeFrameParser()
         else:
             self._parser = FrameParser()
-        self._assembler = CommandAssembler()
+        # cap declared content size: body chunks buffer in the assembler
+        # before a command exists, so resident-memory backpressure can't
+        # see them (chana.mq.message.max-size; RabbitMQ's analogue caps
+        # at 512 MiB, default 128 MiB)
+        self._assembler = CommandAssembler(max_body_size=max_message_size)
         self._out = bytearray()
         self._out_event = asyncio.Event()
         self._writer_task: Optional[asyncio.Task] = None
@@ -478,6 +483,9 @@ class AMQPConnection:
         hoff = offsets[i + 1]
         header = raw[hoff:hoff + lengths[i + 1]]
         body_size = int.from_bytes(header[4:12], "big")
+        max_body = self._assembler.max_body_size
+        if max_body and body_size > max_body:
+            return 0  # over the message-size cap: the assembler raises 501
         channel_id = channels[i]
         consumed = 2
         if body_size == 0:

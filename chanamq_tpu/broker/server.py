@@ -38,6 +38,7 @@ class BrokerServer:
         store: Optional[StoreService] = None,
         max_connections: int = 0,
         backlog: int = 128,
+        max_message_size: int = 128 * 1024 * 1024,
     ) -> None:
         self.broker = broker or Broker(store=store)
         self.host = host
@@ -51,6 +52,7 @@ class BrokerServer:
         # max-connections / backlog, Settings.scala:141-219); 0 = uncapped
         self.max_connections = max_connections
         self.backlog = backlog
+        self.max_message_size = max_message_size
         self.refused_connections = 0
         self._servers: list[asyncio.AbstractServer] = []
         self._connections: set[AMQPConnection] = set()
@@ -104,6 +106,7 @@ class BrokerServer:
             self.broker, reader, writer,
             heartbeat_s=self.heartbeat_s, frame_max=self.frame_max,
             channel_max=self.channel_max,
+            max_message_size=self.max_message_size,
         )
         self._connections.add(connection)
         try:
@@ -192,6 +195,8 @@ class BrokerServer:
             channel_max=config.int("chana.mq.amqp.connection.channel-max"),
             max_connections=config.int("chana.mq.server.max-connections") or 0,
             backlog=config.int("chana.mq.server.backlog") or 128,
+            max_message_size=config.size_bytes("chana.mq.message.max-size")
+            or 0,
         )
 
 

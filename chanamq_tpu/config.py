@@ -67,6 +67,9 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.admin.interface": "127.0.0.1",
     "chana.mq.admin.port": 15672,
     "chana.mq.vhost.default": "/",
+    # declared-content-size cap per message: chunks buffer in the command
+    # assembler before backpressure can account them (0 = unlimited)
+    "chana.mq.message.max-size": "128MiB",
     "chana.mq.store.path": None,
     # sqlite PRAGMA synchronous: NORMAL survives process crashes (WAL
     # replay); FULL additionally fsyncs every group commit so confirmed

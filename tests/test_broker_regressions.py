@@ -296,3 +296,60 @@ async def test_channel_max_enforced():
     assert m is not None and m.body == b"ok"
     await c.close()
     await srv.stop()
+
+
+async def test_oversized_declared_body_rejected():
+    """A content header declaring a body beyond chana.mq.message.max-size
+    must close the connection with FRAME_ERROR instead of buffering toward
+    it — body chunks accumulate in the assembler BEFORE the memory
+    backpressure gauge can see them, so the cap is the only bound
+    (reference: FrameParser's message size limit, FrameParser.scala:67-158)."""
+    import struct
+
+    def raw_frame(t, ch, payload):
+        return struct.pack(">BHI", t, ch, len(payload)) + payload + b"\xce"
+
+    def raw_method(ch, cid, mid, args):
+        return raw_frame(1, ch, struct.pack(">HH", cid, mid) + args)
+
+    def sstr(s):
+        b = s.encode()
+        return bytes([len(b)]) + b
+
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                       max_message_size=1024 * 1024)
+    await srv.start()
+    r, w = await asyncio.open_connection("127.0.0.1", srv.bound_port)
+    w.write(b"AMQP\x00\x00\x09\x01")
+    await r.read(4096)
+    w.write(raw_method(0, 10, 11, struct.pack(">I", 0) + sstr("PLAIN")
+                       + struct.pack(">I", 12) + b"\x00guest\x00guest"
+                       + sstr("en_US")))
+    await r.read(4096)
+    w.write(raw_method(0, 10, 31, struct.pack(">HIH", 100, 131072, 0)))
+    w.write(raw_method(0, 10, 40, sstr("/") + sstr("") + b"\x00"))
+    await r.read(4096)
+    w.write(raw_method(1, 20, 10, sstr("")))
+    await r.read(4096)
+    w.write(raw_method(1, 50, 10, struct.pack(">H", 0) + sstr("capq")
+                       + b"\x00" + struct.pack(">I", 0)))
+    await r.read(4096)
+    # declare a body one byte over the 1 MiB cap
+    w.write(raw_method(1, 60, 40, struct.pack(">H", 0) + sstr("")
+                       + sstr("capq") + b"\x00")
+            + raw_frame(2, 1, struct.pack(">HHQH", 60, 0,
+                                          1024 * 1024 + 1, 0)))
+    data = await asyncio.wait_for(r.read(4096), 5)
+    assert data[7:11] == struct.pack(">HH", 10, 50)  # connection.close
+    assert struct.unpack(">H", data[11:13])[0] == 501  # FRAME_ERROR
+    w.close()
+
+    # a body under the cap (over frame_max) is untouched
+    c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    ch = await c.channel()
+    await ch.queue_declare("okq")
+    ch.basic_publish(bytes(400_000), routing_key="okq")
+    m = await ch.basic_get("okq", no_ack=True)
+    assert m is not None and len(m.body) == 400_000
+    await c.close()
+    await srv.stop()
