@@ -353,3 +353,81 @@ async def test_oversized_declared_body_rejected():
     assert m is not None and len(m.body) == 400_000
     await c.close()
     await srv.stop()
+
+
+async def test_protocol_state_violations_rejected():
+    """Out-of-order protocol moves get the spec's connection errors:
+    publish before Connection.Open (503), content on an unopened channel
+    (504), content frames on channel 0 (505), unknown class (503) — and
+    the broker survives all of them."""
+    import struct
+
+    def raw_frame(t, ch, payload):
+        return struct.pack(">BHI", t, ch, len(payload)) + payload + b"\xce"
+
+    def raw_method(ch, cid, mid, args):
+        return raw_frame(1, ch, struct.pack(">HH", cid, mid) + args)
+
+    def sstr(s):
+        b = s.encode()
+        return bytes([len(b)]) + b
+
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    port = srv.bound_port
+
+    async def fresh(do_open=True, open_channel=False):
+        r, w = await asyncio.open_connection("127.0.0.1", port)
+        w.write(b"AMQP\x00\x00\x09\x01")
+        await r.read(4096)
+        w.write(raw_method(0, 10, 11, struct.pack(">I", 0) + sstr("PLAIN")
+                           + struct.pack(">I", 12) + b"\x00guest\x00guest"
+                           + sstr("en_US")))
+        await r.read(4096)
+        w.write(raw_method(0, 10, 31, struct.pack(">HIH", 100, 131072, 0)))
+        if do_open:
+            w.write(raw_method(0, 10, 40, sstr("/") + sstr("") + b"\x00"))
+            await r.read(4096)
+        if open_channel:
+            w.write(raw_method(1, 20, 10, sstr("")))
+            await r.read(4096)
+        return r, w
+
+    async def expect_conn_close(r, code):
+        data = await asyncio.wait_for(r.read(4096), 5)
+        assert data[7:11] == struct.pack(">HH", 10, 50), data[:16].hex()
+        assert struct.unpack(">H", data[11:13])[0] == code
+
+    publish = (raw_method(1, 60, 40, struct.pack(">H", 0) + sstr("")
+                          + sstr("x") + b"\x00")
+               + raw_frame(2, 1, struct.pack(">HHQH", 60, 0, 1, 0))
+               + raw_frame(3, 1, b"z"))
+
+    r, w = await fresh(do_open=False)
+    w.write(publish)
+    await expect_conn_close(r, 503)  # command-invalid before open
+    w.close()
+
+    r, w = await fresh()
+    w.write(publish)                 # channel 1 never opened
+    await expect_conn_close(r, 504)
+    w.close()
+
+    r, w = await fresh()
+    w.write(raw_frame(2, 0, struct.pack(">HHQH", 60, 0, 1, 0)))
+    await expect_conn_close(r, 505)  # content on channel 0
+    w.close()
+
+    r, w = await fresh(open_channel=True)
+    w.write(raw_method(1, 99, 10, b""))
+    await expect_conn_close(r, 503)  # unknown class
+    w.close()
+
+    # broker healthy after every violation
+    c = await AMQPClient.connect("127.0.0.1", port)
+    ch = await c.channel()
+    await ch.queue_declare("ps_q")
+    ch.basic_publish(b"ok", routing_key="ps_q")
+    assert (await ch.basic_get("ps_q", no_ack=True)).body == b"ok"
+    await c.close()
+    await srv.stop()
