@@ -681,3 +681,66 @@ async def test_origin_death_requeues_outstanding(tmp_path):
                 await node.stop()
             except Exception:
                 pass
+
+
+async def test_double_failover_zero_loss(tmp_path):
+    """Kill the queue's owner TWICE in succession (each time re-resolving
+    the new owner from the ring): every confirmed persistent message must
+    survive both failovers via shared-store recovery and drain completely
+    from the last survivor."""
+    nodes = await start_cluster(tmp_path, 3)
+    live = list(nodes)
+    total = 0
+    try:
+        for wave in range(2):
+            owner_name = live[0].cluster.queue_owner("/", "drill_q")
+            owner = next(n for n in live if n.name == owner_name)
+            survivor = next(n for n in live if n.name != owner_name)
+            c = await AMQPClient.connect("127.0.0.1", survivor.port)
+            ch = await c.channel()
+            await ch.confirm_select()
+            await ch.queue_declare("drill_q", durable=True)
+            for i in range(50):
+                ch.basic_publish(b"w%d-%02d" % (wave, i),
+                                 routing_key="drill_q", properties=PERSISTENT)
+            await ch.wait_unconfirmed_below(1)
+            total += 50
+            await c.close()
+            await owner.stop()
+            live.remove(owner)
+            for _ in range(100):
+                if all(owner_name not in n.cluster.membership.alive_members()
+                       for n in live):
+                    break
+                await asyncio.sleep(0.05)
+            c = await AMQPClient.connect("127.0.0.1", live[0].port)
+            ch = await c.channel()
+            ok = None
+            for _ in range(100):
+                try:
+                    ok = await ch.queue_declare("drill_q", passive=True)
+                    if ok.message_count == total:
+                        break
+                except Exception:
+                    ch = await c.channel()
+                await asyncio.sleep(0.1)
+            assert ok is not None and ok.message_count == total
+            await c.close()
+
+        c = await AMQPClient.connect("127.0.0.1", live[0].port)
+        ch = await c.channel()
+        got = 0
+        while True:
+            m = await ch.basic_get("drill_q")
+            if m is None:
+                break
+            ch.basic_ack(m.delivery_tag)
+            got += 1
+        assert got == total
+        await c.close()
+    finally:
+        for node in live:
+            try:
+                await node.stop()
+            except Exception:
+                pass
