@@ -121,13 +121,29 @@ class ServerChannel:
         # confirm mode
         self.publish_seq = 0  # next publish's confirm seq (1-based when armed)
 
+        # tx mode (reference stubs tx.* with TODO logs,
+        # FrameStage.scala:1261-1272 — implemented here): ordered buffer of
+        # ("publish", AMQCommand) and ("ack"|"requeue"|"drop", Delivery)
+        # entries replayed at tx.commit, discarded at tx.rollback. Settle
+        # entries hold deliveries REMOVED from `unacked` (so a double-settle
+        # inside one tx still raises PRECONDITION_FAILED) with their QoS
+        # budget still held until the commit applies them — tx_held_count/
+        # size keep the channel-global prefetch math honest while the
+        # deliveries are parked outside the unacked dict. tx_bytes tracks
+        # buffered publish bodies for the broker memory gate.
+        self.tx_ops: list = []
+        self.tx_bytes = 0
+        self.tx_held_count = 0
+        self.tx_held_size = 0
+
     # -- qos accounting ----------------------------------------------------
 
     def total_unacked_count(self) -> int:
-        return len(self.unacked)
+        return len(self.unacked) + self.tx_held_count
 
     def total_unacked_size(self) -> int:
-        return sum(d.queued.body_size for d in self.unacked.values())
+        return (sum(d.queued.body_size for d in self.unacked.values())
+                + self.tx_held_size)
 
     def set_qos(self, prefetch_size: int, prefetch_count: int, global_: bool) -> None:
         if global_:
@@ -267,6 +283,45 @@ class ServerChannel:
         self._release_budget(delivery)
         delivery.queue.requeue(delivery)
 
+    # -- tx buffering ------------------------------------------------------
+
+    def tx_stash_settle(self, kind: str, delivery: Delivery) -> None:
+        """Park a validated ack/nack/reject resolution until tx.commit: the
+        delivery leaves `unacked` (a second settle of the same tag inside
+        the tx raises like a double-ack would) but its QoS budget stays
+        held via tx_held_count/size until the commit applies it."""
+        self.unacked.pop(delivery.delivery_tag, None)
+        self.tx_ops.append((kind, delivery))
+        self.tx_held_count += 1
+        self.tx_held_size += delivery.queued.body_size
+
+    def tx_release_held(self, delivery: Delivery) -> None:
+        """Commit is applying this parked settle: drop it from the held-
+        budget counters (ack/requeue/drop then release the rest)."""
+        self.tx_held_count -= 1
+        self.tx_held_size -= delivery.queued.body_size
+
+    def tx_restore_settles(self, ops: list) -> None:
+        """Return parked settles to the unacked set (rollback / implicit
+        rollback / partial-commit failure): the acks are discarded and the
+        deliveries are outstanding again, NOT redelivered (per 0-9-1, a
+        client wanting redelivery issues basic.recover)."""
+        for op in ops:
+            if op[0] != "publish":
+                delivery = op[1]
+                self.tx_release_held(delivery)
+                self.unacked[delivery.delivery_tag] = delivery
+
+    def tx_rollback(self) -> None:
+        """Discard the buffered transaction: publishes vanish (with their
+        memory-gauge accounting), parked settles return to unacked. Shared
+        by tx.rollback and the implicit rollback on channel close."""
+        ops, self.tx_ops = self.tx_ops, []
+        if self.tx_bytes:
+            self.connection.broker.account_memory(-self.tx_bytes)
+            self.tx_bytes = 0
+        self.tx_restore_settles(ops)
+
     def drop(self, delivery: Delivery) -> None:
         self.unacked.pop(delivery.delivery_tag, None)
         self._release_budget(delivery)
@@ -277,8 +332,12 @@ class ServerChannel:
 
     def release_all(self) -> None:
         """On channel close: requeue every unacked delivery and detach all
-        consumers (reference: FrameStage.scala:144-153 semantics)."""
+        consumers (reference: FrameStage.scala:144-153 semantics). An open
+        transaction implicitly rolls back: buffered publishes are dropped
+        (with their memory accounting) and tx-held deliveries requeue like
+        any other unacked delivery."""
         self.closed = True
+        self.tx_rollback()
         # highest tag first: each requeue then lands at the queue head via
         # the O(1) appendleft fast path instead of a linear insert scan
         for tag in sorted(self.unacked, reverse=True):

@@ -480,6 +480,8 @@ class AMQPConnection:
         channel = self.channels.get(channels[i])
         if channel is None:
             return 0  # full path raises the proper channel error
+        if channel.mode is ChannelMode.TX:
+            return 0  # transactional publish: generic path buffers it
         hoff = offsets[i + 1]
         header = raw[hoff:hoff + lengths[i + 1]]
         body_size = int.from_bytes(header[4:12], "big")
@@ -540,6 +542,16 @@ class AMQPConnection:
         blob + queue-log rows — all in one group-commit batch). Free for
         single-node transient traffic: with no remote pushes and no enqueue
         windows recorded, flush([]) resolves immediately."""
+        await self._settle_remote_failures()
+        if self._pending_confirms:
+            intervals, self._confirm_marks = self._confirm_marks, []
+            await self.broker.store.flush(intervals)
+
+    async def _settle_remote_failures(self) -> None:
+        """Drain pipelined remote pushes and account for their failures:
+        a failure covering a confirm-armed (or tx-commit) publish escalates
+        — never acknowledge over a lost remote push; best-effort failures
+        just log (shared by the confirm barrier and tx.commit)."""
         if self._remote_pending:
             await self._drain_remote()
         if self._remote_failures:
@@ -554,9 +566,6 @@ class AMQPConnection:
             for failure, _ in failures:
                 log.warning("remote push failed (best-effort publish): %r",
                             failure)
-        if self._pending_confirms:
-            intervals, self._confirm_marks = self._confirm_marks, []
-            await self.broker.store.flush(intervals)
 
     async def _drain_remote(self) -> None:
         """Flush buffered remote push records: one queue.push_many RPC per
@@ -722,7 +731,7 @@ class AMQPConnection:
         elif cid == ClassId.CONFIRM:
             self._on_confirm(command)
         elif cid == ClassId.TX:
-            self._on_tx(command)
+            await self._on_tx(command)
         elif cid == ClassId.ACCESS:
             self.send_method(command.channel, am.Access.RequestOk(ticket=0))
         else:
@@ -1017,24 +1026,35 @@ class AMQPConnection:
         elif isinstance(method, am.Basic.Ack):
             deliveries = channel.resolve_tags(method.delivery_tag, method.multiple)
             self._check_settled_tags(channel, method, deliveries)
-            for delivery in deliveries:
-                channel.ack(delivery)
+            if channel.mode is ChannelMode.TX:
+                self._tx_stash_settles(channel, "ack", deliveries)
+            else:
+                for delivery in deliveries:
+                    channel.ack(delivery)
         elif isinstance(method, am.Basic.Nack):
             deliveries = channel.resolve_tags(method.delivery_tag, method.multiple)
             self._check_settled_tags(channel, method, deliveries)
-            for delivery in deliveries:
-                if method.requeue:
-                    channel.requeue(delivery)
-                else:
-                    channel.drop(delivery)
+            if channel.mode is ChannelMode.TX:
+                self._tx_stash_settles(
+                    channel, "requeue" if method.requeue else "drop", deliveries)
+            else:
+                for delivery in deliveries:
+                    if method.requeue:
+                        channel.requeue(delivery)
+                    else:
+                        channel.drop(delivery)
         elif isinstance(method, am.Basic.Reject):
             deliveries = channel.resolve_tags(method.delivery_tag, False)
             self._check_settled_tags(channel, method, deliveries, multiple=False)
-            for delivery in deliveries:
-                if method.requeue:
-                    channel.requeue(delivery)
-                else:
-                    channel.drop(delivery)
+            if channel.mode is ChannelMode.TX:
+                self._tx_stash_settles(
+                    channel, "requeue" if method.requeue else "drop", deliveries)
+            else:
+                for delivery in deliveries:
+                    if method.requeue:
+                        channel.requeue(delivery)
+                    else:
+                        channel.drop(delivery)
         elif isinstance(method, (am.Basic.Recover, am.Basic.RecoverAsync)):
             self._on_recover(channel, method.requeue)
             if isinstance(method, am.Basic.Recover):
@@ -1043,6 +1063,13 @@ class AMQPConnection:
             raise HardError(
                 ErrorCode.COMMAND_INVALID, f"unexpected {method.NAME}",
                 method.CLASS_ID, method.METHOD_ID)
+
+    @staticmethod
+    def _tx_stash_settles(
+        channel: ServerChannel, kind: str, deliveries: list
+    ) -> None:
+        for delivery in deliveries:
+            channel.tx_stash_settle(kind, delivery)
 
     @staticmethod
     def _check_settled_tags(
@@ -1083,6 +1110,8 @@ class AMQPConnection:
         channel = self.channels.get(channel_id)
         if channel is None:
             return 0
+        if channel.mode is ChannelMode.TX:
+            return 0  # transactional ack: generic path buffers it
         tag = int.from_bytes(raw[off + 4:off + 12], "big")
         multiple = raw[off + 12] & 1 == 1
         self._fused_skip = 1
@@ -1145,6 +1174,8 @@ class AMQPConnection:
         channel = self.channels.get(command.channel)
         if channel is None:
             return False  # full path raises the proper channel error
+        if channel.mode is ChannelMode.TX:
+            return False  # transactional publish: _on_publish buffers it
         props = command.properties or BasicProperties()
         seq = self._arm_confirm(channel)
         routed, deliverable = self.broker.publish_sync(
@@ -1159,6 +1190,15 @@ class AMQPConnection:
         return True
 
     async def _on_publish(self, channel: ServerChannel, command: AMQCommand) -> None:
+        if channel.mode is ChannelMode.TX:
+            # transactional publish: buffer until tx.commit. The body counts
+            # against the broker memory gate while parked (a flood inside a
+            # never-committed tx must not be invisible to backpressure).
+            self._has_published = True
+            channel.tx_ops.append(("publish", command))
+            channel.tx_bytes += len(command.body)
+            self.broker.account_memory(len(command.body))
+            return
         method = command.method
         if (method.mandatory or method.immediate) and self._remote_pending:
             # a mandatory/immediate publish awaits its remote push inline:
@@ -1321,11 +1361,113 @@ class AMQPConnection:
                 ErrorCode.COMMAND_INVALID, f"unexpected {method.NAME}",
                 method.CLASS_ID, method.METHOD_ID)
 
-    def _on_tx(self, command: AMQCommand) -> None:
-        # The reference stubs tx.* with TODO logs (FrameStage.scala:1261-1272);
-        # we reject cleanly so clients fail fast instead of silently.
+    async def _on_tx(self, command: AMQCommand) -> None:
+        """tx class with real transactional semantics (EXCEEDS the
+        reference, which stubs tx.* with TODO logs,
+        FrameStage.scala:1261-1272). tx.select flips the channel into
+        transactional mode; publishes and ack/nack/reject buffer in order
+        until tx.commit replays them behind the same durability barrier
+        publisher confirms use, or tx.rollback discards them. Per 0-9-1,
+        rollback returns settled-in-tx deliveries to the unacked set
+        WITHOUT redelivering — a client wanting redelivery issues
+        basic.recover."""
         method = command.method
-        self._channel(command)
-        raise ChannelError(
-            ErrorCode.NOT_IMPLEMENTED, "transactions not implemented",
-            method.CLASS_ID, method.METHOD_ID)
+        channel = self._channel(command)
+        cid = command.channel
+        if isinstance(method, am.Tx.Select):
+            if channel.mode is ChannelMode.CONFIRM:
+                # confirm and tx are mutually exclusive (RabbitMQ contract;
+                # mirror of the guard in _on_confirm)
+                raise ChannelError(
+                    ErrorCode.PRECONDITION_FAILED, "channel is in confirm mode",
+                    method.CLASS_ID, method.METHOD_ID)
+            channel.mode = ChannelMode.TX
+            self.send_method(cid, am.Tx.SelectOk())
+        elif isinstance(method, am.Tx.Commit):
+            self._require_tx(channel, method)
+            await self._tx_commit(channel)
+            self.send_method(cid, am.Tx.CommitOk())
+        elif isinstance(method, am.Tx.Rollback):
+            self._require_tx(channel, method)
+            channel.tx_rollback()
+            self.send_method(cid, am.Tx.RollbackOk())
+        else:
+            raise HardError(
+                ErrorCode.COMMAND_INVALID, f"unexpected {method.NAME}",
+                method.CLASS_ID, method.METHOD_ID)
+
+    @staticmethod
+    def _require_tx(channel: ServerChannel, method: am.Method) -> None:
+        if channel.mode is not ChannelMode.TX:
+            raise ChannelError(
+                ErrorCode.PRECONDITION_FAILED, "channel is not transactional",
+                method.CLASS_ID, method.METHOD_ID)
+
+    async def _tx_commit(self, channel: ServerChannel) -> None:
+        """Replay the buffered ops in arrival order. Mandatory/immediate
+        Basic.Returns render before Tx.CommitOk (RabbitMQ ordering), and
+        CommitOk is only sent after (a) every clustered push the replay
+        buffered has been accepted by its owner and (b) the store has
+        committed every persistent write the replay enqueued — the same
+        promise a publisher confirm makes, per-op mark windows included."""
+        ops, channel.tx_ops = channel.tx_ops, []
+        if channel.tx_bytes:
+            self.broker.account_memory(-channel.tx_bytes)
+            channel.tx_bytes = 0
+        store = self.broker.store
+        marks: list[tuple[int, int]] = []
+        idx = 0
+        try:
+            while idx < len(ops):
+                op = ops[idx]
+                if op[0] == "publish":
+                    pub = op[1]
+                    method = pub.method
+                    if ((method.mandatory or method.immediate)
+                            and self._remote_pending):
+                        # same guard as _on_publish: a mandatory/immediate
+                        # publish awaits its remote push inline, so drain
+                        # the buffered pipeline first to keep per-queue FIFO
+                        await self._drain_remote()
+                    props = pub.properties or BasicProperties()
+                    buffered_before = len(self._remote_pending)
+                    routed, deliverable = await self.broker.publish(
+                        self.vhost_name, method.exchange, method.routing_key,
+                        props, pub.body,
+                        mandatory=method.mandatory, immediate=method.immediate,
+                        header_raw=pub.header_raw, marks=marks,
+                        exrk_raw=method._values.get("exrk_raw"),
+                        pending=self._remote_pending)
+                    if len(self._remote_pending) > buffered_before:
+                        # a commit-replayed push is always strict: a lost
+                        # remote push must fail the commit, never be
+                        # silently dropped
+                        self._remote_strict = True
+                    self._publish_aftermath(
+                        channel, pub, props, routed, deliverable, None)
+                else:
+                    kind, delivery = op
+                    channel.tx_release_held(delivery)
+                    before = store.mark()
+                    if kind == "ack":
+                        channel.ack(delivery)
+                    elif kind == "requeue":
+                        channel.requeue(delivery)
+                    else:
+                        channel.drop(delivery)
+                    # the settle path never awaits, so this window covers
+                    # exactly the store deletes/updates this settle enqueued
+                    marks.append((before, store.mark()))
+                idx += 1
+        except BaseException:
+            # partial-commit failure (e.g. a replayed publish hit a deleted
+            # exchange): the error closes the channel, but ops not yet
+            # applied must not vanish — parked settles return to unacked so
+            # the channel teardown requeues their deliveries. The failed op
+            # itself is consumed (a raising publish routed nowhere; settles
+            # never raise); later publishes drop, matching implicit-rollback
+            # semantics.
+            channel.tx_restore_settles(ops[idx + 1:])
+            raise
+        await self._settle_remote_failures()
+        await store.flush(marks)

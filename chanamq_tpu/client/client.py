@@ -972,3 +972,14 @@ class ClientChannel:
     async def confirm_select(self) -> None:
         await self._rpc(am.Confirm.Select(), (am.Confirm.SelectOk,))
         self.confirm_mode = True
+
+    # -- tx ----------------------------------------------------------------
+
+    async def tx_select(self) -> None:
+        await self._rpc(am.Tx.Select(), (am.Tx.SelectOk,))
+
+    async def tx_commit(self) -> None:
+        await self._rpc(am.Tx.Commit(), (am.Tx.CommitOk,))
+
+    async def tx_rollback(self) -> None:
+        await self._rpc(am.Tx.Rollback(), (am.Tx.RollbackOk,))
