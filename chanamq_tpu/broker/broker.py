@@ -220,6 +220,9 @@ class Broker:
             )
             for routing_key, queue_name, bind_args in stored_ex.binds:
                 exchange.matcher.bind(routing_key, queue_name, bind_args)
+            for routing_key, dest_name, bind_args in stored_ex.ex_binds:
+                exchange.ensure_ex_matcher().bind(
+                    routing_key, dest_name, bind_args)
             vhost.exchanges[stored_ex.name] = exchange
         for sq in await self.store.all_queues():
             vhost = self.vhosts.get(sq.vhost)
@@ -432,11 +435,16 @@ class Broker:
         if name == "" or name.startswith("amq."):
             raise BrokerError(
                 ErrorCode.ACCESS_REFUSED, f"exchange '{name}' is reserved")
-        if if_unused and not exchange.matcher.is_empty():
+        if if_unused and not exchange.is_unused():
             raise BrokerError(ErrorCode.PRECONDITION_FAILED, f"exchange '{name}' in use")
         del vhost.exchanges[name]
+        # e2e bindings die with the exchange on BOTH sides: its own source
+        # matchers go with the object; binds from other exchanges to it are
+        # swept here (RabbitMQ parity)
+        vhost.drop_exchange_refs(name)
         if exchange.durable:
             await self.store.delete_exchange(vhost_name, name)
+        await self.store.delete_exchange_binds_dest(vhost_name, name)
         if self.cluster is not None:
             self.cluster.broadcast_bg("meta.apply", {
                 "kind": "exchange.deleted", "vhost": vhost_name, "name": name})
@@ -568,6 +576,58 @@ class Broker:
                 "key": routing_key, "args": arguments,
             })
 
+    async def bind_exchange(
+        self, vhost_name: str, destination: str, source: str,
+        routing_key: str, arguments: Optional[dict] = None,
+    ) -> None:
+        """Exchange-to-exchange binding (EXCEEDS the reference, which stubs
+        Exchange.Bind with a TODO log, FrameStage.scala:1023-1027): messages
+        accepted by `source` whose routing key/headers match the binding
+        flow on to `destination`, which routes them further. Durable when
+        both ends are durable."""
+        vhost = self.vhost(vhost_name)
+        src = vhost.exchanges.get(source)
+        if src is None:
+            raise BrokerError(ErrorCode.NOT_FOUND, f"no exchange '{source}'")
+        dst = vhost.exchanges.get(destination)
+        if dst is None:
+            raise BrokerError(ErrorCode.NOT_FOUND, f"no exchange '{destination}'")
+        if source == "" or destination == "":
+            raise BrokerError(
+                ErrorCode.ACCESS_REFUSED, "cannot bind the default exchange")
+        added = src.ensure_ex_matcher().bind(routing_key, destination, arguments)
+        if added and src.durable and dst.durable:
+            await self.store.insert_exchange_bind(
+                vhost_name, source, destination, routing_key, arguments)
+        if added and self.cluster is not None:
+            self.cluster.broadcast_bg("meta.apply", {
+                "kind": "exbind.added", "vhost": vhost_name,
+                "source": source, "destination": destination,
+                "key": routing_key, "args": arguments,
+            })
+
+    async def unbind_exchange(
+        self, vhost_name: str, destination: str, source: str,
+        routing_key: str, arguments: Optional[dict] = None,
+    ) -> None:
+        vhost = self.vhost(vhost_name)
+        src = vhost.exchanges.get(source)
+        if src is None:
+            raise BrokerError(ErrorCode.NOT_FOUND, f"no exchange '{source}'")
+        removed = (src.ex_matcher is not None
+                   and src.ex_matcher.unbind(routing_key, destination, arguments))
+        if removed and src.durable:
+            await self.store.delete_exchange_bind(
+                vhost_name, source, destination, routing_key)
+        if removed and self.cluster is not None:
+            self.cluster.broadcast_bg("meta.apply", {
+                "kind": "exbind.removed", "vhost": vhost_name,
+                "source": source, "destination": destination,
+                "key": routing_key, "args": arguments,
+            })
+        if removed and src.auto_delete and src.is_unused():
+            await self.delete_exchange(vhost_name, source)
+
     async def unbind_queue(
         self, vhost_name: str, queue_name: str, exchange_name: str,
         routing_key: str, arguments: Optional[dict] = None,
@@ -588,7 +648,7 @@ class Broker:
                 "exchange": exchange_name, "queue": queue_name,
                 "key": routing_key, "args": arguments,
             })
-        if removed and exchange.auto_delete and exchange.matcher.is_empty():
+        if removed and exchange.auto_delete and exchange.is_unused():
             await self.delete_exchange(vhost_name, exchange_name)
 
     async def delete_queue(
@@ -618,13 +678,13 @@ class Broker:
         queue.deleted = True
         del vhost.queues[queue.name]
         count = len(queue.messages)
-        # unbind everywhere (reference broadcasts QueueDeleted on pub-sub)
+        # unbind everywhere (reference broadcasts QueueDeleted on pub-sub);
+        # auto-delete sources go through delete_exchange so e2e bindings on
+        # both sides are swept and the deletion replicates cluster-wide
         for exchange in list(vhost.exchanges.values()):
             if exchange.matcher.unbind_queue(queue.name) and exchange.auto_delete \
-                    and exchange.matcher.is_empty() and exchange.name:
-                vhost.exchanges.pop(exchange.name, None)
-                if exchange.durable:
-                    await self.store.delete_exchange(vhost.name, exchange.name)
+                    and exchange.is_unused() and exchange.name:
+                await self.delete_exchange(vhost.name, exchange.name)
         for consumer in list(queue.consumers):
             consumer.detach()
             queue.consumers.remove(consumer)

@@ -56,7 +56,7 @@ SERVER_PROPERTIES = {
         "publisher_confirms": True,
         "basic.nack": True,
         "consumer_cancel_notify": False,
-        "exchange_exchange_bindings": False,
+        "exchange_exchange_bindings": True,
     },
 }
 
@@ -883,12 +883,20 @@ class AMQPConnection:
                 self.vhost_name, method.exchange, if_unused=method.if_unused)
             if not method.nowait:
                 self.send_method(cid, am.Exchange.DeleteOk())
-        elif isinstance(method, (am.Exchange.Bind, am.Exchange.Unbind)):
-            # exchange-to-exchange bindings: the reference stubs these with a
-            # TODO log (FrameStage.scala:1023-1027); we reject them cleanly.
-            raise ChannelError(
-                ErrorCode.NOT_IMPLEMENTED, "exchange-to-exchange bindings",
-                method.CLASS_ID, method.METHOD_ID)
+        elif isinstance(method, am.Exchange.Bind):
+            # exchange-to-exchange bindings (EXCEEDS the reference, which
+            # stubs these with a TODO log, FrameStage.scala:1023-1027)
+            await self.broker.bind_exchange(
+                self.vhost_name, method.destination, method.source,
+                method.routing_key, method.arguments)
+            if not method.nowait:
+                self.send_method(cid, am.Exchange.BindOk())
+        elif isinstance(method, am.Exchange.Unbind):
+            await self.broker.unbind_exchange(
+                self.vhost_name, method.destination, method.source,
+                method.routing_key, method.arguments)
+            if not method.nowait:
+                self.send_method(cid, am.Exchange.UnbindOk())
         else:
             raise HardError(
                 ErrorCode.COMMAND_INVALID, f"unexpected {method.NAME}",
@@ -1034,27 +1042,11 @@ class AMQPConnection:
         elif isinstance(method, am.Basic.Nack):
             deliveries = channel.resolve_tags(method.delivery_tag, method.multiple)
             self._check_settled_tags(channel, method, deliveries)
-            if channel.mode is ChannelMode.TX:
-                self._tx_stash_settles(
-                    channel, "requeue" if method.requeue else "drop", deliveries)
-            else:
-                for delivery in deliveries:
-                    if method.requeue:
-                        channel.requeue(delivery)
-                    else:
-                        channel.drop(delivery)
+            self._settle_negative(channel, deliveries, method.requeue)
         elif isinstance(method, am.Basic.Reject):
             deliveries = channel.resolve_tags(method.delivery_tag, False)
             self._check_settled_tags(channel, method, deliveries, multiple=False)
-            if channel.mode is ChannelMode.TX:
-                self._tx_stash_settles(
-                    channel, "requeue" if method.requeue else "drop", deliveries)
-            else:
-                for delivery in deliveries:
-                    if method.requeue:
-                        channel.requeue(delivery)
-                    else:
-                        channel.drop(delivery)
+            self._settle_negative(channel, deliveries, method.requeue)
         elif isinstance(method, (am.Basic.Recover, am.Basic.RecoverAsync)):
             self._on_recover(channel, method.requeue)
             if isinstance(method, am.Basic.Recover):
@@ -1070,6 +1062,21 @@ class AMQPConnection:
     ) -> None:
         for delivery in deliveries:
             channel.tx_stash_settle(kind, delivery)
+
+    def _settle_negative(
+        self, channel: ServerChannel, deliveries: list, requeue: bool
+    ) -> None:
+        """Shared nack/reject settle: requeue or drop, buffered on a tx
+        channel (the two methods differ only in how tags were resolved)."""
+        if channel.mode is ChannelMode.TX:
+            self._tx_stash_settles(
+                channel, "requeue" if requeue else "drop", deliveries)
+        else:
+            for delivery in deliveries:
+                if requeue:
+                    channel.requeue(delivery)
+                else:
+                    channel.drop(delivery)
 
     @staticmethod
     def _check_settled_tags(

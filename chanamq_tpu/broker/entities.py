@@ -657,9 +657,24 @@ class Exchange:
         self.internal = internal
         self.arguments = arguments or {}
         self.matcher: Matcher = matcher_for(type)
+        # exchange-to-exchange bindings (EXCEEDS the reference, which stubs
+        # Exchange.Bind/Unbind with TODO logs, FrameStage.scala:1023-1027):
+        # a second matcher whose "queue" targets are destination exchange
+        # names. None until the first e2e bind, so the common single-hop
+        # publish path pays nothing for the feature.
+        self.ex_matcher: Optional[Matcher] = None
+
+    def ensure_ex_matcher(self) -> Matcher:
+        if self.ex_matcher is None:
+            self.ex_matcher = matcher_for(self.type)
+        return self.ex_matcher
 
     def route(self, routing_key: str, headers: Optional[dict] = None) -> set[str]:
         return self.matcher.route(routing_key, headers)
+
+    def is_unused(self) -> bool:
+        return self.matcher.is_empty() and (
+            self.ex_matcher is None or self.ex_matcher.is_empty())
 
     def equivalent(self, type: str, durable: bool, auto_delete: bool, internal: bool) -> bool:
         return (
@@ -698,11 +713,43 @@ class VHost:
     def route(
         self, exchange_name: str, routing_key: str, headers: Optional[dict] = None
     ) -> Optional[set[str]]:
-        """Resolve target queue names; None when the exchange doesn't exist."""
+        """Resolve target queue names; None when the exchange doesn't exist.
+
+        With exchange-to-exchange bindings present, routing is a cycle-safe
+        breadth-first walk of the exchange graph (RabbitMQ semantics: each
+        hop re-matches the message's ORIGINAL routing key / headers against
+        the next exchange's bindings; queues reached via multiple paths
+        receive one copy). Exchanges without e2e bindings take the original
+        single-hop path untouched."""
         exchange = self.exchanges.get(exchange_name)
         if exchange is None:
             return None
         if exchange_name == "":
             # default exchange: implicit binding queue-name == routing-key
             return {routing_key} if routing_key in self.queues else set()
-        return exchange.route(routing_key, headers)
+        if exchange.ex_matcher is None:
+            return exchange.route(routing_key, headers)
+        queues = set(exchange.route(routing_key, headers))
+        visited = {exchange_name}
+        frontier = exchange.ex_matcher.route(routing_key, headers)
+        while frontier:
+            hop: set[str] = set()
+            for ex_name in frontier:
+                if ex_name in visited:
+                    continue
+                visited.add(ex_name)
+                ex = self.exchanges.get(ex_name)
+                if ex is None:
+                    continue  # dangling bind to a deleted exchange
+                queues |= ex.route(routing_key, headers)
+                if ex.ex_matcher is not None:
+                    hop |= ex.ex_matcher.route(routing_key, headers)
+            frontier = hop
+        return queues
+
+    def drop_exchange_refs(self, name: str) -> None:
+        """An exchange was deleted: remove every e2e binding that targets
+        it (RabbitMQ deletes bindings on either side of a dead exchange)."""
+        for exchange in self.exchanges.values():
+            if exchange.ex_matcher is not None:
+                exchange.ex_matcher.unbind_queue(name)

@@ -757,6 +757,22 @@ class ClientChannel:
             auto_delete=auto_delete, internal=internal, arguments=arguments,
         ), (am.Exchange.DeclareOk,))
 
+    async def exchange_bind(
+        self, destination: str, source: str, routing_key: str = "",
+        arguments: Optional[dict] = None,
+    ) -> None:
+        await self._rpc(am.Exchange.Bind(
+            destination=destination, source=source, routing_key=routing_key,
+            arguments=arguments or {}), (am.Exchange.BindOk,))
+
+    async def exchange_unbind(
+        self, destination: str, source: str, routing_key: str = "",
+        arguments: Optional[dict] = None,
+    ) -> None:
+        await self._rpc(am.Exchange.Unbind(
+            destination=destination, source=source, routing_key=routing_key,
+            arguments=arguments or {}), (am.Exchange.UnbindOk,))
+
     async def exchange_delete(self, exchange: str, *, if_unused: bool = False) -> None:
         await self._rpc(am.Exchange.Delete(exchange=exchange, if_unused=if_unused),
                         (am.Exchange.DeleteOk,))

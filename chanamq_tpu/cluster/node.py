@@ -417,6 +417,12 @@ class ClusterNode:
                         {"key": key, "queue": queue, "args": args or {}}
                         for key, queue, args in exchange.matcher.bindings()
                     ],
+                    "ex_binds": [
+                        {"key": key, "destination": dest, "args": args or {}}
+                        for key, dest, args in (
+                            exchange.ex_matcher.bindings()
+                            if exchange.ex_matcher is not None else [])
+                    ],
                 })
         return {
             "vhosts": {v.name: v.active for v in self.broker.vhosts.values()},
@@ -475,9 +481,27 @@ class ClusterNode:
             for bind in payload.get("binds") or []:
                 exchange.matcher.bind(
                     str(bind["key"]), str(bind["queue"]), bind.get("args"))
+            for bind in payload.get("ex_binds") or []:
+                exchange.ensure_ex_matcher().bind(
+                    str(bind["key"]), str(bind["destination"]), bind.get("args"))
             return {}
         if kind == "exchange.deleted":
             vhost.exchanges.pop(str(payload["name"]), None)
+            vhost.drop_exchange_refs(str(payload["name"]))
+            return {}
+        if kind == "exbind.added":
+            exchange = vhost.exchanges.get(str(payload["source"]))
+            if exchange is not None:
+                exchange.ensure_ex_matcher().bind(
+                    str(payload["key"]), str(payload["destination"]),
+                    payload.get("args") or None)
+            return {}
+        if kind == "exbind.removed":
+            exchange = vhost.exchanges.get(str(payload["source"]))
+            if exchange is not None and exchange.ex_matcher is not None:
+                exchange.ex_matcher.unbind(
+                    str(payload["key"]), str(payload["destination"]),
+                    payload.get("args") or None)
             return {}
         if kind == "bind.added":
             exchange = vhost.exchanges.get(str(payload["exchange"]))

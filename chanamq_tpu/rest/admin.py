@@ -190,6 +190,9 @@ class AdminServer:
                 "auto_delete": exchange.auto_delete,
                 "internal": exchange.internal,
                 "bindings": len(exchange.matcher.bindings()),
+                "exchange_bindings": (
+                    len(exchange.ex_matcher.bindings())
+                    if exchange.ex_matcher is not None else 0),
             }
             for exchange in vhost.exchanges.values()
         ]

@@ -66,6 +66,8 @@ class StoredExchange:
     arguments: dict[str, Any] = field(default_factory=dict)
     # (routing_key, queue, arguments)
     binds: list[tuple[str, str, Optional[dict]]] = field(default_factory=list)
+    # exchange-to-exchange bindings: (routing_key, destination, arguments)
+    ex_binds: list[tuple[str, str, Optional[dict]]] = field(default_factory=list)
 
 
 class StoreService:
@@ -273,6 +275,26 @@ class StoreService:
         raise NotImplementedError
 
     async def delete_queue_binds(self, vhost: str, queue: str) -> None:
+        raise NotImplementedError
+
+    # -- exchange-to-exchange binds (no reference analogue: the reference
+    #    stubs Exchange.Bind/Unbind, FrameStage.scala:1023-1027) -----------
+
+    async def insert_exchange_bind(
+        self, vhost: str, source: str, destination: str, routing_key: str,
+        arguments: Optional[dict],
+    ) -> None:
+        raise NotImplementedError
+
+    async def delete_exchange_bind(
+        self, vhost: str, source: str, destination: str, routing_key: str
+    ) -> None:
+        raise NotImplementedError
+
+    async def delete_exchange_binds_dest(
+        self, vhost: str, destination: str
+    ) -> None:
+        """Remove every e2e bind targeting a deleted destination exchange."""
         raise NotImplementedError
 
     # -- cluster worker-id allocation (reference: GlobalNodeIdService hands

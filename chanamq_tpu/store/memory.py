@@ -171,6 +171,7 @@ class MemoryStore(StoreService):
         stored = copy.deepcopy(ex)
         if existing:
             stored.binds = existing.binds
+            stored.ex_binds = existing.ex_binds
         self.exchanges[(ex.vhost, ex.name)] = stored
         return _DONE
 
@@ -209,6 +210,29 @@ class MemoryStore(StoreService):
         for (vh, _), ex in self.exchanges.items():
             if vh == vhost:
                 ex.binds = [b for b in ex.binds if b[1] != queue]
+        return _DONE
+
+    def insert_exchange_bind(self, vhost, source, destination, routing_key, arguments):
+        ex = self.exchanges.get((vhost, source))
+        if ex is not None:
+            entry = (routing_key, destination, arguments)
+            if entry not in ex.ex_binds:
+                ex.ex_binds.append(entry)
+        return _DONE
+
+    def delete_exchange_bind(self, vhost, source, destination, routing_key):
+        ex = self.exchanges.get((vhost, source))
+        if ex is not None:
+            ex.ex_binds = [
+                b for b in ex.ex_binds
+                if not (b[0] == routing_key and b[1] == destination)
+            ]
+        return _DONE
+
+    def delete_exchange_binds_dest(self, vhost, destination):
+        for (vh, _), ex in self.exchanges.items():
+            if vh == vhost:
+                ex.ex_binds = [b for b in ex.ex_binds if b[1] != destination]
         return _DONE
 
     async def allocate_worker_id(self) -> int:

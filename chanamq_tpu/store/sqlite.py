@@ -71,6 +71,10 @@ CREATE TABLE IF NOT EXISTS binds (
   vhost TEXT, exchange TEXT, queue TEXT, routing_key TEXT, arguments TEXT,
   PRIMARY KEY (vhost, exchange, queue, routing_key)
 );
+CREATE TABLE IF NOT EXISTS exchange_binds (
+  vhost TEXT, exchange TEXT, destination TEXT, routing_key TEXT, arguments TEXT,
+  PRIMARY KEY (vhost, exchange, destination, routing_key)
+);
 CREATE TABLE IF NOT EXISTS vhosts (name TEXT PRIMARY KEY, active INTEGER);
 CREATE TABLE IF NOT EXISTS cluster_kv (key TEXT PRIMARY KEY, value INTEGER);
 CREATE TABLE IF NOT EXISTS queue_metas_deleted (
@@ -633,17 +637,22 @@ class SqliteStore(StoreService):
             binds = db.execute(
                 "SELECT routing_key, queue, arguments FROM binds "
                 "WHERE vhost=? AND exchange=?", (vhost, name)).fetchall()
-            return row, binds
+            ex_binds = db.execute(
+                "SELECT routing_key, destination, arguments FROM exchange_binds "
+                "WHERE vhost=? AND exchange=?", (vhost, name)).fetchall()
+            return row, binds, ex_binds
 
         out = await self._submit(q)
         if out is None:
             return None
-        row, binds = out
+        row, binds, ex_binds = out
         return StoredExchange(
             vhost=row[0], name=row[1], type=row[2], durable=bool(row[3]),
             auto_delete=bool(row[4]), internal=bool(row[5]),
             arguments=json.loads(row[6] or "{}"),
             binds=[(b[0], b[1], json.loads(b[2]) if b[2] else None) for b in binds],
+            ex_binds=[(b[0], b[1], json.loads(b[2]) if b[2] else None)
+                      for b in ex_binds],
         )
 
     async def all_exchanges(self, vhost: Optional[str] = None) -> list[StoredExchange]:
@@ -666,8 +675,28 @@ class SqliteStore(StoreService):
         def w(db: sqlite3.Connection):
             db.execute("DELETE FROM exchanges WHERE vhost=? AND name=?", (vhost, name))
             db.execute("DELETE FROM binds WHERE vhost=? AND exchange=?", (vhost, name))
+            db.execute("DELETE FROM exchange_binds WHERE vhost=? AND exchange=?",
+                       (vhost, name))
 
         return self._submit(w)
+
+    def insert_exchange_bind(self, vhost, source, destination, routing_key, arguments):
+        return self._submit(lambda db: db.execute(
+            "INSERT OR REPLACE INTO exchange_binds VALUES (?,?,?,?,?)",
+            (vhost, source, destination, routing_key,
+             json.dumps(arguments) if arguments else None),
+        ), guard=False)
+
+    def delete_exchange_bind(self, vhost, source, destination, routing_key):
+        return self._submit(lambda db: db.execute(
+            "DELETE FROM exchange_binds "
+            "WHERE vhost=? AND exchange=? AND destination=? AND routing_key=?",
+            (vhost, source, destination, routing_key)), guard=False)
+
+    def delete_exchange_binds_dest(self, vhost, destination):
+        return self._submit(lambda db: db.execute(
+            "DELETE FROM exchange_binds WHERE vhost=? AND destination=?",
+            (vhost, destination)), guard=False)
 
     def insert_bind(self, vhost, exchange, queue, routing_key, arguments):
         return self._submit(lambda db: db.execute(
