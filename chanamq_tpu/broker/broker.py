@@ -95,6 +95,29 @@ class Broker:
         self._sweep_task: Optional[asyncio.Task] = None
         self._msg_delete_buf: list[int] = []
         self._started = False
+        # publish route cache (SINGLE-NODE publish_sync only; the clustered
+        # publish path never consults it): (vhost, exchange, routing-key)
+        # -> resolved local Queue list. A flow's route repeats on every
+        # message, so the hot loop skips the matcher walk AND the
+        # name->Queue resolution; any topology mutation on this node
+        # (declare/delete/bind/unbind) clears the cache outright — churn is
+        # rare relative to publishes, and clearing frees dead Queue objects
+        # immediately. Only plain key-routed single-hop exchanges cache —
+        # headers matchers and e2e graphs route on more than the key.
+        # High-cardinality keys (per-message-unique topics) would thrash:
+        # after _ROUTE_CACHE_STRIKES overflow-clears the cache disables
+        # for the broker's lifetime (same adaptive pattern as the
+        # connection's publish-args cache).
+        self._route_cache: Optional[dict[tuple[str, str, str], list[Queue]]] = {}
+        self._route_cache_strikes = 0
+
+    _ROUTE_CACHE_MAX = 4096
+    _ROUTE_CACHE_STRIKES = 4
+
+    def invalidate_routes(self) -> None:
+        """Topology changed: cached publish routes are stale."""
+        if self._route_cache:
+            self._route_cache.clear()
 
     def account_memory(self, delta: int) -> None:
         """Track resident message-body bytes (passivation drops, hydration
@@ -230,6 +253,7 @@ class Broker:
                 continue
             vhost.queues[sq.name] = await self._load_stored_queue(sq)
         n_q = sum(len(v.queues) for v in self.vhosts.values())
+        self.invalidate_routes()
         if n_q:
             log.info("recovered %d vhosts, %d queues", len(self.vhosts), n_q)
 
@@ -319,6 +343,7 @@ class Broker:
             if name in vhost.queues:
                 return vhost.queues[name]
             vhost.queues[name] = queue
+            self.invalidate_routes()
             if self.cluster is not None:
                 self.cluster.claim_queue(queue)
             return queue
@@ -335,6 +360,7 @@ class Broker:
                     arguments=dict(meta.get("arguments") or {}),
                 )
                 vhost.queues[name] = queue
+                self.invalidate_routes()
                 self.cluster.claim_queue(queue)
                 return queue
         return None
@@ -360,6 +386,7 @@ class Broker:
         if vhost is None:
             vhost = VHost(name)
             self.vhosts[name] = vhost
+            self.invalidate_routes()
             await self.store.insert_vhost(name, True)
             if self.cluster is not None:
                 self.cluster.broadcast_bg(
@@ -370,6 +397,7 @@ class Broker:
         vhost = self.vhosts.pop(name, None)
         if vhost is None:
             return False
+        self.invalidate_routes()
         for queue in list(vhost.queues.values()):
             queue.deleted = True
         await self.store.delete_vhost(name)
@@ -411,6 +439,7 @@ class Broker:
             auto_delete=auto_delete, internal=internal, arguments=arguments,
         )
         vhost.exchanges[name] = exchange
+        self.invalidate_routes()
         if durable:
             await self.store.insert_exchange(StoredExchange(
                 vhost=vhost_name, name=name, type=ex_type, durable=durable,
@@ -438,6 +467,7 @@ class Broker:
         if if_unused and not exchange.is_unused():
             raise BrokerError(ErrorCode.PRECONDITION_FAILED, f"exchange '{name}' in use")
         del vhost.exchanges[name]
+        self.invalidate_routes()
         # e2e bindings die with the exchange on BOTH sides: its own source
         # matchers go with the object; binds from other exchanges to it are
         # swept here (RabbitMQ parity)
@@ -487,6 +517,7 @@ class Broker:
             ttl_ms=ttl_ms, arguments=arguments,
         )
         vhost.queues[name] = queue
+        self.invalidate_routes()
         if durable and not exclusive_owner:
             await self.store.insert_queue_meta(StoredQueue(
                 vhost=vhost_name, name=name, durable=durable,
@@ -566,6 +597,8 @@ class Broker:
             raise BrokerError(
                 ErrorCode.ACCESS_REFUSED, "cannot bind to the default exchange")
         added = exchange.matcher.bind(routing_key, queue_name, arguments)
+        if added:
+            self.invalidate_routes()
         if added and exchange.durable and self._queue_is_durable(vhost_name, queue_name):
             await self.store.insert_bind(
                 vhost_name, exchange_name, queue_name, routing_key, arguments)
@@ -596,6 +629,10 @@ class Broker:
             raise BrokerError(
                 ErrorCode.ACCESS_REFUSED, "cannot bind the default exchange")
         added = src.ensure_ex_matcher().bind(routing_key, destination, arguments)
+        if added:
+            # an e2e bind turns a cached single-hop route stale AND makes
+            # the source uncacheable (ex_matcher now set)
+            self.invalidate_routes()
         if added and src.durable and dst.durable:
             await self.store.insert_exchange_bind(
                 vhost_name, source, destination, routing_key, arguments)
@@ -616,6 +653,8 @@ class Broker:
             raise BrokerError(ErrorCode.NOT_FOUND, f"no exchange '{source}'")
         removed = (src.ex_matcher is not None
                    and src.ex_matcher.unbind(routing_key, destination, arguments))
+        if removed:
+            self.invalidate_routes()
         if removed and src.durable:
             await self.store.delete_exchange_bind(
                 vhost_name, source, destination, routing_key)
@@ -639,6 +678,8 @@ class Broker:
         if exchange is None:
             raise BrokerError(ErrorCode.NOT_FOUND, f"no exchange '{exchange_name}'")
         removed = exchange.matcher.unbind(routing_key, queue_name, arguments)
+        if removed:
+            self.invalidate_routes()
         if removed and exchange.durable:
             await self.store.delete_bind(
                 vhost_name, exchange_name, queue_name, routing_key)
@@ -677,6 +718,7 @@ class Broker:
     async def _remove_queue(self, vhost: VHost, queue: Queue) -> int:
         queue.deleted = True
         del vhost.queues[queue.name]
+        self.invalidate_routes()
         count = len(queue.messages)
         # unbind everywhere (reference broadcasts QueueDeleted on pub-sub);
         # auto-delete sources go through delete_exchange so e2e bindings on
@@ -784,11 +826,36 @@ class Broker:
         per-message hot loop skips the coroutine machinery. Callers must
         check ``broker.cluster is None`` first."""
         assert self.cluster is None
+        cache = self._route_cache
+        if cache is not None:
+            key = (vhost_name, exchange_name, routing_key)
+            queues = cache.get(key)
+            if queues is not None:
+                # cache hit: resolved Queue objects, no matcher walk
+                self.metrics.published(len(body))
+                return self._publish_local(
+                    queues, exchange_name, routing_key, properties,
+                    body, immediate, header_raw, marks, exrk_raw)
         vhost, queue_names = self._publish_route(
             vhost_name, exchange_name, routing_key, properties)
         self.metrics.published(len(body))
+        queues = [vhost.queues[qn] for qn in queue_names if qn in vhost.queues]
+        if cache is not None:
+            exchange = vhost.exchanges.get(exchange_name)
+            if exchange_name == "" or (
+                exchange is not None
+                and exchange.ex_matcher is None
+                and exchange.type != "headers"
+            ):
+                if len(cache) >= self._ROUTE_CACHE_MAX:
+                    cache.clear()
+                    self._route_cache_strikes += 1
+                    if self._route_cache_strikes >= self._ROUTE_CACHE_STRIKES:
+                        self._route_cache = None
+                if self._route_cache is not None:
+                    cache[key] = queues
         return self._publish_local(
-            vhost, queue_names, exchange_name, routing_key, properties,
+            queues, exchange_name, routing_key, properties,
             body, immediate, header_raw, marks, exrk_raw)
 
     def _publish_route(
@@ -816,8 +883,7 @@ class Broker:
 
     def _publish_local(
         self,
-        vhost: VHost,
-        queue_names: set[str],
+        queues: list[Queue],
         exchange_name: str,
         routing_key: str,
         properties: BasicProperties,
@@ -827,7 +893,6 @@ class Broker:
         marks: Optional[list[tuple[int, int]]],
         exrk_raw: Optional[bytes] = None,
     ) -> tuple[bool, bool]:
-        queues = [vhost.queues[qn] for qn in queue_names if qn in vhost.queues]
         if not queues:
             return (False, True)
         if immediate and not any(

@@ -83,6 +83,16 @@ _PUBLISH_ARGS_CACHE: dict[bytes, tuple[str, str, bytes]] = {}
 _PUBLISH_CACHE_STRIKES = 4
 _publish_cache_strikes = 0
 
+# fused-path content-header cache: a flow's publishes repeat the exact
+# header payload (same properties, same body size), so the decoded
+# BasicProperties caches keyed by the raw header bytes. The shared instance
+# is safe: nothing mutates a decoded properties object (per-message state
+# like published_ns lives on Message). Same adaptive churn-disable as the
+# args cache — varying body sizes change the key, so mixed-size traffic
+# self-disables instead of thrashing.
+_HEADER_CACHE: dict[bytes, BasicProperties] = {}
+_header_cache_strikes = 0
+
 
 class ConnectionClosed(Exception):
     pass
@@ -514,10 +524,22 @@ class AMQPConnection:
                 j += 1
             body = first if chunks is None else b"".join(chunks)
             consumed = j - i
-        try:
-            _class_id, _size, props = BasicProperties.decode_header(header)
-        except Exception:
-            return 0  # generic path raises the proper SYNTAX_ERROR
+        global _header_cache_strikes
+        props = None
+        header_caching = _header_cache_strikes < _PUBLISH_CACHE_STRIKES
+        if header_caching:
+            props = _HEADER_CACHE.get(header)
+        if props is None:
+            try:
+                _class_id, _size, props = BasicProperties.decode_header(header)
+            except Exception:
+                return 0  # generic path raises the proper SYNTAX_ERROR
+            if header_caching:
+                if len(_HEADER_CACHE) >= 1024:
+                    _HEADER_CACHE.clear()
+                    _header_cache_strikes += 1
+                if _header_cache_strikes < _PUBLISH_CACHE_STRIKES:
+                    _HEADER_CACHE[header] = props
         # count the skip before publish: the except handlers in
         # _consume_scan resume past this publish's frames on soft errors
         self._fused_skip = consumed

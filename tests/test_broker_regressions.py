@@ -431,3 +431,58 @@ async def test_protocol_state_violations_rejected():
     assert (await ch.basic_get("ps_q", no_ack=True)).body == b"ok"
     await c.close()
     await srv.stop()
+
+
+async def test_route_cache_invalidates_on_topology_churn(client):
+    """The publish route cache must never serve a stale route: rebinding,
+    unbinding, queue deletion and redeclaration mid-flow all take effect on
+    the very next publish (topology epoch bump)."""
+    ch = await client.channel()
+    await ch.exchange_declare("rc_ex", "direct")
+    await ch.queue_declare("rc_q1")
+    await ch.queue_declare("rc_q2")
+    await ch.queue_bind("rc_q1", "rc_ex", "k")
+
+    async def get(q):
+        for _ in range(50):
+            msg = await ch.basic_get(q, no_ack=True)
+            if msg is not None:
+                return msg
+            await asyncio.sleep(0.01)
+        return None
+
+    # warm the cache, then churn
+    for _ in range(3):
+        ch.basic_publish(b"warm", exchange="rc_ex", routing_key="k")
+    await ch.queue_unbind("rc_q1", "rc_ex", "k")
+    await ch.queue_bind("rc_q2", "rc_ex", "k")
+    ch.basic_publish(b"moved", exchange="rc_ex", routing_key="k")
+    assert (await get("rc_q2")).body == b"moved"
+    await asyncio.sleep(0.05)
+    # q1 got only the warmup messages, not the post-churn one
+    bodies = []
+    while True:
+        m = await ch.basic_get("rc_q1", no_ack=True)
+        if m is None:
+            break
+        bodies.append(m.body)
+    assert bodies == [b"warm"] * 3
+
+    # queue deletion invalidates a cached resolved-queue reference
+    ch.basic_publish(b"pre-delete", exchange="rc_ex", routing_key="k")
+    assert (await get("rc_q2")).body == b"pre-delete"
+    await ch.queue_delete("rc_q2")
+    ch.basic_publish(b"into-void", exchange="rc_ex", routing_key="k")
+    await ch.queue_declare("rc_q2")
+    await ch.queue_bind("rc_q2", "rc_ex", "k")
+    ch.basic_publish(b"reborn", exchange="rc_ex", routing_key="k")
+    assert (await get("rc_q2")).body == b"reborn"
+
+    # default-exchange routes churn with queue lifecycle too
+    await ch.queue_declare("rc_dq")
+    ch.basic_publish(b"d1", routing_key="rc_dq")
+    assert (await get("rc_dq")).body == b"d1"
+    await ch.queue_delete("rc_dq")
+    await ch.queue_declare("rc_dq")
+    ch.basic_publish(b"d2", routing_key="rc_dq")
+    assert (await get("rc_dq")).body == b"d2"
