@@ -25,7 +25,7 @@ from ..cluster.idgen import IdGenerator
 from ..store.api import StoredExchange, StoredMessage, StoredQueue, StoreService
 from ..store.memory import MemoryStore
 from ..utils.metrics import Metrics
-from .entities import Exchange, Message, Queue, VHost
+from .entities import Exchange, Message, Queue, VHost, now_ms
 
 log = logging.getLogger("chanamq.broker")
 
@@ -310,6 +310,7 @@ class Broker:
                     queue._passivated.append(qm)
                 max_offset = max(max_offset, offset)
         queue.next_offset = max_offset + 1
+        queue.ready_bytes = sum(q.body_size for q in queue.messages)
         if sq.unacks:
             # Recovered unacks re-enter the queue as ready messages. They
             # must survive a second crash, so convert the store rows:
@@ -499,18 +500,21 @@ class Broker:
             if existing is None:
                 raise BrokerError(ErrorCode.NOT_FOUND, f"no queue '{name}'")
             self._check_exclusive(existing, connection_id)
+            existing.touch()
             return existing
         if name.startswith("amq."):
             raise BrokerError(
                 ErrorCode.ACCESS_REFUSED, f"queue name '{name}' is reserved")
         if existing is not None:
             self._check_exclusive(existing, connection_id)
+            existing.touch()
             return existing
         arguments = arguments or {}
         ttl_ms = arguments.get("x-message-ttl")
         if ttl_ms is not None and (not isinstance(ttl_ms, int) or ttl_ms < 0):
             raise BrokerError(
                 ErrorCode.PRECONDITION_FAILED, "invalid x-message-ttl")
+        self._validate_queue_args(arguments)
         queue = Queue(
             self, vhost_name, name, durable=durable,
             exclusive_owner=exclusive_owner, auto_delete=auto_delete,
@@ -582,6 +586,39 @@ class Broker:
         site, _ = self.queue_site(vhost_name, name, connection_id)
         if site == "none":
             raise BrokerError(ErrorCode.NOT_FOUND, f"no queue '{name}'")
+
+    @staticmethod
+    def _validate_queue_args(arguments: dict[str, Any]) -> None:
+        """Queue-argument extensions (beyond the reference's x-message-ttl):
+        dead-letter routing, length/byte caps, idle expiry. Invalid values
+        fail the declare with PRECONDITION_FAILED, RabbitMQ-style."""
+        for arg_name in ("x-max-length", "x-max-length-bytes"):
+            v = arguments.get(arg_name)
+            if v is not None and (not isinstance(v, int) or v < 0):
+                raise BrokerError(
+                    ErrorCode.PRECONDITION_FAILED, f"invalid {arg_name}")
+        expires = arguments.get("x-expires")
+        if expires is not None and (not isinstance(expires, int) or expires <= 0):
+            raise BrokerError(
+                ErrorCode.PRECONDITION_FAILED, "invalid x-expires")
+        dlx = arguments.get("x-dead-letter-exchange")
+        if dlx is not None and not isinstance(dlx, str):
+            raise BrokerError(
+                ErrorCode.PRECONDITION_FAILED, "invalid x-dead-letter-exchange")
+        dlx_rk = arguments.get("x-dead-letter-routing-key")
+        if dlx_rk is not None and not isinstance(dlx_rk, str):
+            raise BrokerError(
+                ErrorCode.PRECONDITION_FAILED,
+                "invalid x-dead-letter-routing-key")
+        if dlx_rk is not None and dlx is None:
+            raise BrokerError(
+                ErrorCode.PRECONDITION_FAILED,
+                "x-dead-letter-routing-key requires x-dead-letter-exchange")
+        overflow = arguments.get("x-overflow")
+        if overflow is not None and overflow != "drop-head":
+            raise BrokerError(
+                ErrorCode.PRECONDITION_FAILED,
+                "only x-overflow=drop-head is supported")
 
     async def bind_queue(
         self, vhost_name: str, queue_name: str, exchange_name: str,
@@ -756,6 +793,83 @@ class Broker:
                 log.exception("auto-delete of queue %s failed", queue_name)
 
         asyncio.get_event_loop().create_task(_delete())
+
+    # -- dead-lettering (no reference analogue: RabbitMQ-style DLX) --------
+
+    def dead_letter(self, queue: Queue, qm: "QueuedMessage", reason: str) -> None:  # noqa: F821
+        """Forward a dead message (expired / rejected / maxlen-overflowed)
+        to the queue's x-dead-letter-exchange, stamping the x-death header
+        (count per (queue, reason), first-death markers) and clearing the
+        per-message expiration so it cannot immediately re-expire in the
+        dead-letter queue. Cycle safety: an automatic death (expired /
+        maxlen) that has already passed through this queue for the same
+        reason drops instead of looping; explicit client rejects may cycle
+        (RabbitMQ semantics). A missing DLX target drops the message."""
+        msg = qm.message
+        props = msg.properties
+        headers = dict(props.headers) if props.headers else {}
+        raw_deaths = headers.get("x-death")
+        deaths = ([dict(d) for d in raw_deaths if isinstance(d, dict)]
+                  if isinstance(raw_deaths, list) else [])
+        entry = next(
+            (d for d in deaths
+             if d.get("queue") == queue.name and d.get("reason") == reason),
+            None)
+        if entry is not None:
+            if reason != "rejected" and not any(
+                    d.get("reason") == "rejected" for d in deaths):
+                # fully-automatic cycle (only expired/maxlen deaths in the
+                # history): drop instead of looping forever. A history that
+                # contains an explicit reject is a client-driven retry
+                # topology (work queue -> TTL retry queue -> work queue)
+                # and keeps flowing, per RabbitMQ's cycle rule.
+                self.unrefer(msg)
+                return
+            entry["count"] = int(entry.get("count", 1)) + 1
+            deaths.remove(entry)
+            deaths.insert(0, entry)
+        else:
+            deaths.insert(0, {
+                "queue": queue.name, "reason": reason,
+                "exchange": msg.exchange,
+                "routing-keys": [msg.routing_key],
+                "count": 1,
+            })
+        headers["x-death"] = deaths
+        headers.setdefault("x-first-death-queue", queue.name)
+        headers.setdefault("x-first-death-reason", reason)
+        headers.setdefault("x-first-death-exchange", msg.exchange)
+        new_props = props.copy()
+        new_props.headers = headers
+        new_props.expiration = None
+        routing_key = queue.dlx_rk if queue.dlx_rk is not None else msg.routing_key
+        self.metrics.dead_lettered_msgs += 1
+        asyncio.get_event_loop().create_task(self._dead_letter_publish(
+            queue.vhost, queue.dlx, routing_key, new_props, msg))
+
+    async def _dead_letter_publish(
+        self, vhost_name: str, exchange: str, routing_key: str,
+        props: BasicProperties, msg: Message,
+    ) -> None:
+        """Deliver one dead-lettered message, hydrating a passivated body
+        from the store first. The original reference is released only after
+        the read so the blob can't be deleted out from under us."""
+        try:
+            body = msg.body
+            if body is None:
+                stored = await self.store.select_messages([msg.id])
+                sm = stored.get(msg.id)
+                if sm is None:
+                    return  # blob already gone: nothing to forward
+                body = sm.body
+            await self.publish(vhost_name, exchange, routing_key, props, body)
+        except BrokerError as exc:
+            log.warning("dead-letter publish to '%s' dropped: %s",
+                        exchange, exc.text)
+        except Exception:
+            log.exception("dead-letter publish to '%s' failed", exchange)
+        finally:
+            self.unrefer(msg)
 
     # -- publish path (reference: FrameStage.scala:462-607 +
     #    ExchangeEntity.publish ExchangeEntity.scala:287-331) --------------
@@ -1069,10 +1183,21 @@ class Broker:
         try:
             while True:
                 await asyncio.sleep(self.message_sweep_interval_s)
+                now = now_ms()
+                expired_queues: list[Queue] = []
                 for vhost in self.vhosts.values():
                     for queue in vhost.queues.values():
                         before = len(queue.messages)
                         queue._expire_head()
                         self.metrics.expired_msgs += before - len(queue.messages)
+                        # x-expires: the queue itself dies after idling
+                        # unused (no consumers, no gets/declares)
+                        if (queue.expires_ms and not queue.consumers
+                                and now - queue.last_used >= queue.expires_ms):
+                            expired_queues.append(queue)
+                for queue in expired_queues:
+                    log.info("queue %s idle-expired (x-expires=%dms)",
+                             queue.name, queue.expires_ms)
+                    self.schedule_queue_delete(queue.vhost, queue.name)
         except asyncio.CancelledError:
             pass

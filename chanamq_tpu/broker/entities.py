@@ -174,6 +174,19 @@ class Queue:
         self.auto_delete = auto_delete
         self.ttl_ms = ttl_ms
         self.arguments = arguments or {}
+        # queue-argument extensions beyond the reference (which supports
+        # only x-message-ttl, QueueEntity.scala:288-297): dead-letter
+        # routing, ready-backlog length/byte caps (drop-head overflow), and
+        # idle auto-expiry — RabbitMQ-compatible argument names/semantics
+        args = self.arguments
+        self.dlx: Optional[str] = args.get("x-dead-letter-exchange")
+        self.dlx_rk: Optional[str] = args.get("x-dead-letter-routing-key")
+        self.max_length: Optional[int] = args.get("x-max-length")
+        self.max_length_bytes: Optional[int] = args.get("x-max-length-bytes")
+        self.expires_ms: Optional[int] = args.get("x-expires")
+        self.last_used = now_ms()
+        # body bytes across READY messages (limit enforcement + gauge)
+        self.ready_bytes = 0
 
         self.messages: deque[QueuedMessage] = deque()
         self.next_offset = 1
@@ -199,6 +212,10 @@ class Queue:
         self._passivated: deque[QueuedMessage] = deque()
 
     # -- introspection ----------------------------------------------------
+
+    def touch(self) -> None:
+        """Mark the queue used (x-expires idle clock reset)."""
+        self.last_used = now_ms()
 
     @property
     def message_count(self) -> int:
@@ -238,11 +255,23 @@ class Queue:
                            body_size=body_size)
         self.next_offset += 1
         self.messages.append(qm)
+        self.ready_bytes += qm.body_size
         if self.durable and message.persisted:
             self.broker.store.insert_queue_msg_nowait(
                 self.vhost, self.name, qm.offset, message.id,
                 qm.body_size, qm.expire_at_ms,
             )
+        # length/byte caps: drop-head overflow, dead-lettering each victim
+        # (x-overflow=drop-head is the only supported policy; declare
+        # rejects others). Runs before passivation so a dropped entry is
+        # never paged out.
+        if self.max_length is not None or self.max_length_bytes is not None:
+            self._drop_overflow()
+            if not self.messages or self.messages[-1] is not qm:
+                # the pushed entry itself overflowed (tiny cap): it is
+                # settled, so skip passivation and just wake dispatch
+                self.schedule_dispatch()
+                return qm
         # deep-backlog passivation (reference: MessageEntity pages ANY
         # inactive body out — transient included — persisting it first,
         # MessageEntity.scala:171-186): beyond the per-queue resident
@@ -276,6 +305,32 @@ class Queue:
         self.schedule_dispatch()
         return qm
 
+    def _drop_overflow(self) -> None:
+        """Enforce x-max-length / x-max-length-bytes by dropping from the
+        head (oldest first), dead-lettering each victim (RabbitMQ
+        drop-head semantics: the cap bounds READY messages)."""
+        messages = self.messages
+        while messages and (
+            (self.max_length is not None and len(messages) > self.max_length)
+            or (self.max_length_bytes is not None
+                and self.ready_bytes > self.max_length_bytes)
+        ):
+            qm = messages.popleft()
+            self.ready_bytes -= qm.body_size
+            self._advance_watermark(qm)
+            self._settle_dead(qm, "maxlen")
+        if self._passivated:
+            self._prune_passivated()
+
+    def _settle_dead(self, qm: QueuedMessage, reason: str) -> None:
+        """A message died in this queue (expired / rejected / overflowed):
+        forward to the dead-letter exchange when configured, else release
+        the reference."""
+        if self.dlx and not qm.dead:
+            self.broker.dead_letter(self, qm, reason)
+        else:
+            self.broker.unrefer(qm.message)
+
     # -- dequeue / dispatch ------------------------------------------------
 
     def _expire_head(self) -> None:
@@ -285,8 +340,9 @@ class Queue:
         while self.messages and (
                 self.messages[0].dead or self.messages[0].is_expired(now)):
             qm = self.messages.popleft()
+            self.ready_bytes -= qm.body_size
             self._advance_watermark(qm)
-            self.broker.unrefer(qm.message)
+            self._settle_dead(qm, "expired")
             expired = True
         if expired and self._passivated:
             # settled (expired) entries must leave the passivated deque too:
@@ -362,6 +418,7 @@ class Queue:
             if consumer is None:
                 break
             messages.popleft()
+            self.ready_bytes -= qm.body_size
             delivery = consumer.deliver(self, qm)
             self._advance_watermark(qm)
             if delivery is None:  # no_ack: consumed immediately
@@ -498,18 +555,21 @@ class Queue:
         first (the reference Promise-latches Get on the lazy store load,
         MessageEntity.scala:82-102). The entry is CLAIMED (popped) before
         the store read so a concurrent dispatch pass can't starve the get."""
+        self.last_used = now_ms()
         self._prune_passivated()
         while True:
             self._expire_head()
             if not self.messages:
                 return None
             qm = self.messages.popleft()
+            self.ready_bytes -= qm.body_size
             msg = qm.message
             if msg.body is None:
                 try:
                     stored = await self.broker.store.select_messages([msg.id])
                 except Exception:
                     self.messages.appendleft(qm)
+                    self.ready_bytes += qm.body_size
                     raise
                 sm = stored.get(msg.id)
                 if sm is None:  # blob gone: drop and try the next entry
@@ -528,13 +588,16 @@ class Queue:
 
     # -- ack / requeue -----------------------------------------------------
 
-    def ack(self, delivery: Delivery) -> None:
+    def _settle_store(self, delivery: Delivery) -> None:
         self.outstanding.pop(delivery.queued.offset, None)
         if self.durable and delivery.queued.message.persisted:
             buf = self._unack_del_buf
             buf.append(delivery.queued.message.id)
             if len(buf) == 1:
                 asyncio.get_event_loop().call_soon(self._flush_unack_deletes)
+
+    def ack(self, delivery: Delivery) -> None:
+        self._settle_store(delivery)
         self.broker.unrefer(delivery.queued.message)
 
     def _flush_unack_deletes(self) -> None:
@@ -545,8 +608,10 @@ class Queue:
             )
 
     def drop(self, delivery: Delivery) -> None:
-        """Reject without requeue: same store cleanup as ack."""
-        self.ack(delivery)
+        """Reject without requeue: same store cleanup as ack, then the
+        message dead-letters (reason "rejected") when a DLX is set."""
+        self._settle_store(delivery)
+        self._settle_dead(delivery.queued, "rejected")
 
     def requeue(self, delivery: Delivery) -> None:
         """Return an unacked message to the queue, in offset order, marked
@@ -561,12 +626,13 @@ class Queue:
                         self.vhost, self.name, [qm.message.id]
                     )
                 )
-            self.broker.unrefer(qm.message)
+            self._settle_dead(qm, "expired")
             return
         # insert keeping offset order. Requeues nearly always precede the
         # whole backlog (they were at the head when delivered), so the O(1)
         # end checks cover the hot cases; the linear scan is the rare
         # interleaved-offset fallback.
+        self.ready_bytes += qm.body_size
         if not self.messages or qm.offset < self.messages[0].offset:
             self.messages.appendleft(qm)
         elif qm.offset > self.messages[-1].offset:
@@ -611,6 +677,7 @@ class Queue:
             self._advance_watermark(qm)
             self.broker.unrefer(qm.message)
         self.messages.clear()
+        self.ready_bytes = 0
         self._passivated.clear()
         if self.durable:
             self.broker.store_bg(
@@ -621,6 +688,7 @@ class Queue:
     def add_consumer(self, consumer: "Consumer") -> None:
         self.consumers.append(consumer)
         self.had_consumer = True
+        self.last_used = now_ms()
         self.schedule_dispatch()
 
     def remove_consumer(self, consumer: "Consumer") -> bool:
@@ -630,6 +698,7 @@ class Queue:
             self.consumers.remove(consumer)
         except ValueError:
             return False
+        self.last_used = now_ms()
         if self.auto_delete and self.had_consumer and not self.consumers:
             return True
         return False

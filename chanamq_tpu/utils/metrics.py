@@ -62,6 +62,7 @@ class Metrics:
         self.returned_msgs = 0
         self.confirmed_msgs = 0
         self.expired_msgs = 0
+        self.dead_lettered_msgs = 0
         self.connections_opened = 0
         self.connections_closed = 0
         # accepts refused at the listener cap (chana.mq.server.max-connections)
@@ -89,6 +90,7 @@ class Metrics:
             "returned_msgs": self.returned_msgs,
             "confirmed_msgs": self.confirmed_msgs,
             "expired_msgs": self.expired_msgs,
+            "dead_lettered_msgs": self.dead_lettered_msgs,
             "connections_opened": self.connections_opened,
             "connections_closed": self.connections_closed,
             "connections_refused": self.connections_refused,

@@ -744,3 +744,50 @@ async def test_double_failover_zero_loss(tmp_path):
                 await node.stop()
             except Exception:
                 pass
+
+
+async def test_exchange_to_exchange_binds_replicated(tmp_path):
+    """e2e bindings replicate cluster-wide (exbind meta events + the join
+    snapshot): a publish entering at any node routes through the full
+    exchange graph, and unbind replicates too."""
+    nodes = await start_cluster(tmp_path, 3)
+    try:
+        c0 = await AMQPClient.connect("127.0.0.1", nodes[0].port)
+        ch0 = await c0.channel()
+        await ch0.exchange_declare("g_src", "direct", durable=True)
+        await ch0.exchange_declare("g_dst", "fanout", durable=True)
+        await ch0.queue_declare("g_q", durable=True)
+        await ch0.exchange_bind("g_dst", "g_src", "k")
+        await ch0.queue_bind("g_q", "g_dst", "")
+        await asyncio.sleep(0.3)
+        # every node's local routing sees the graph
+        for node in nodes:
+            vhost = node.server.broker.vhosts["/"]
+            assert vhost.route("g_src", "k") == {"g_q"}, node.name
+        # publish entering at node 2 flows through the replicated graph
+        c2 = await AMQPClient.connect("127.0.0.1", nodes[2].port)
+        ch2 = await c2.channel()
+        ch2.basic_publish(b"graph", exchange="g_src", routing_key="k",
+                          properties=PERSISTENT)
+        await asyncio.sleep(0.3)
+        ok = await ch2.queue_declare("g_q", passive=True)
+        assert ok.message_count == 1
+        # unbind replicates: post-unbind publishes route nowhere
+        await ch0.exchange_unbind("g_dst", "g_src", "k")
+        await asyncio.sleep(0.3)
+        for node in nodes:
+            vhost = node.server.broker.vhosts["/"]
+            assert vhost.route("g_src", "k") == set(), node.name
+        # a node joining AFTER the bind existed learns it from the snapshot
+        await ch0.exchange_bind("g_dst", "g_src", "k2")
+        await asyncio.sleep(0.3)
+        joiner = await start_node(str(tmp_path / "shared.db"), [nodes[0].name])
+        nodes.append(joiner)
+        await asyncio.sleep(0.5)
+        vhost = joiner.server.broker.vhosts["/"]
+        assert vhost.route("g_src", "k2") == {"g_q"}
+        await c0.close()
+        await c2.close()
+    finally:
+        for node in nodes:
+            await node.stop()

@@ -1,0 +1,278 @@
+"""Queue-argument extensions: dead-letter exchanges, length/byte caps with
+drop-head overflow, and idle queue auto-expiry (x-expires).
+
+All EXCEED the reference, whose only queue argument is x-message-ttl
+(QueueEntity.scala:288-297). Semantics follow RabbitMQ: x-death headers
+accumulate per (queue, reason), automatic deaths (expired/maxlen) never
+cycle, per-message expiration is cleared on dead-lettering, and caps bound
+READY messages with oldest-first drop.
+"""
+
+import asyncio
+
+import pytest
+
+from chanamq_tpu.amqp.properties import BasicProperties
+from chanamq_tpu.broker.broker import Broker
+from chanamq_tpu.broker.server import BrokerServer
+from chanamq_tpu.client import AMQPClient
+from chanamq_tpu.client.client import ChannelClosedError
+
+pytestmark = pytest.mark.asyncio
+
+
+@pytest.fixture
+async def server():
+    srv = BrokerServer(broker=Broker(message_sweep_interval_s=0.1),
+                       host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    yield srv
+    await srv.stop()
+
+
+@pytest.fixture
+async def client(server):
+    c = await AMQPClient.connect("127.0.0.1", server.bound_port)
+    yield c
+    await c.close()
+
+
+async def drain(ch, queue, n, timeout=3.0):
+    out = []
+    deadline = asyncio.get_event_loop().time() + timeout
+    while len(out) < n and asyncio.get_event_loop().time() < deadline:
+        msg = await ch.basic_get(queue, no_ack=True)
+        if msg is None:
+            await asyncio.sleep(0.02)
+            continue
+        out.append(msg)
+    return out
+
+
+async def declare_dlq(ch, dlq="dlq"):
+    await ch.exchange_declare("dlx_ex", "fanout")
+    await ch.queue_declare(dlq)
+    await ch.queue_bind(dlq, "dlx_ex", "")
+
+
+# -- max-length ------------------------------------------------------------
+
+
+async def test_max_length_drops_oldest(client):
+    ch = await client.channel()
+    await ch.queue_declare("cap_q", arguments={"x-max-length": 3})
+    for i in range(5):
+        ch.basic_publish(b"m%d" % i, routing_key="cap_q")
+    await asyncio.sleep(0.05)
+    ok = await ch.queue_declare("cap_q", passive=True)
+    assert ok.message_count == 3
+    bodies = [m.body for m in await drain(ch, "cap_q", 3)]
+    assert bodies == [b"m2", b"m3", b"m4"]
+
+
+async def test_max_length_bytes_drops_oldest(client):
+    ch = await client.channel()
+    await ch.queue_declare("capb_q", arguments={"x-max-length-bytes": 250})
+    for i in range(4):
+        ch.basic_publish(bytes([48 + i]) * 100, routing_key="capb_q")
+    await asyncio.sleep(0.05)
+    ok = await ch.queue_declare("capb_q", passive=True)
+    assert ok.message_count == 2  # 2x100 <= 250 < 3x100
+    bodies = [m.body for m in await drain(ch, "capb_q", 2)]
+    assert bodies == [b"2" * 100, b"3" * 100]
+
+
+async def test_maxlen_overflow_dead_letters(client):
+    ch = await client.channel()
+    await declare_dlq(ch)
+    await ch.queue_declare("capd_q", arguments={
+        "x-max-length": 1, "x-dead-letter-exchange": "dlx_ex"})
+    ch.basic_publish(b"first", routing_key="capd_q")
+    ch.basic_publish(b"second", routing_key="capd_q")
+    got = await drain(ch, "dlq", 1)
+    assert [m.body for m in got] == [b"first"]
+    death = got[0].properties.headers["x-death"][0]
+    assert death["queue"] == "capd_q"
+    assert death["reason"] == "maxlen"
+    assert death["count"] == 1
+
+
+# -- dead-letter on expiry and reject --------------------------------------
+
+
+async def test_ttl_expiry_dead_letters_with_x_death(client):
+    ch = await client.channel()
+    await declare_dlq(ch)
+    await ch.queue_declare("ttl_q", arguments={
+        "x-message-ttl": 60, "x-dead-letter-exchange": "dlx_ex",
+        "x-dead-letter-routing-key": "was-ttl"})
+    ch.basic_publish(b"doomed", routing_key="ttl_q",
+                     properties=BasicProperties(expiration="60"))
+    got = await drain(ch, "dlq", 1)
+    assert [m.body for m in got] == [b"doomed"]
+    msg = got[0]
+    assert msg.routing_key == "was-ttl"
+    # expiration cleared so it cannot instantly re-expire in the DLQ
+    assert msg.properties.expiration is None
+    death = msg.properties.headers["x-death"][0]
+    assert death["reason"] == "expired"
+    assert death["queue"] == "ttl_q"
+    assert death["routing-keys"] == ["ttl_q"]
+    assert msg.properties.headers["x-first-death-reason"] == "expired"
+    assert msg.properties.headers["x-first-death-queue"] == "ttl_q"
+
+
+async def test_reject_dead_letters(client):
+    ch = await client.channel()
+    await declare_dlq(ch)
+    await ch.queue_declare("rej_q", arguments={
+        "x-dead-letter-exchange": "dlx_ex"})
+    ch.basic_publish(b"bad", routing_key="rej_q")
+    msg = await (await drain_one(ch, "rej_q"))
+    ch.basic_reject(msg.delivery_tag, requeue=False)
+    got = await drain(ch, "dlq", 1)
+    assert [m.body for m in got] == [b"bad"]
+    death = got[0].properties.headers["x-death"][0]
+    assert death["reason"] == "rejected"
+
+
+async def drain_one(ch, queue, timeout=3.0):
+    async def inner():
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            msg = await ch.basic_get(queue)
+            if msg is not None:
+                return msg
+            await asyncio.sleep(0.02)
+        return None
+    return inner()
+
+
+async def test_nack_requeue_false_dead_letters_and_count_increments(client):
+    """A reject cycle through the same queue increments the x-death count
+    (client-driven rejects may legally cycle)."""
+    ch = await client.channel()
+    await ch.exchange_declare("back_ex", "fanout")
+    await ch.queue_declare("cycle_q", arguments={
+        "x-dead-letter-exchange": "back_ex"})
+    await ch.queue_bind("cycle_q", "back_ex", "")  # DLX routes BACK to cycle_q
+    ch.basic_publish(b"again", routing_key="cycle_q")
+    for expected_count in (1, 2):
+        msg = await (await drain_one(ch, "cycle_q"))
+        assert msg is not None
+        ch.basic_nack(msg.delivery_tag, requeue=False)
+        await asyncio.sleep(0.1)
+    msg = await (await drain_one(ch, "cycle_q"))
+    assert msg is not None
+    death = msg.properties.headers["x-death"][0]
+    assert death["reason"] == "rejected" and death["count"] == 2
+
+
+async def test_automatic_death_does_not_cycle(server, client):
+    """expired/maxlen dead-letters that route back to the same queue drop on
+    the second pass instead of looping forever."""
+    ch = await client.channel()
+    await ch.exchange_declare("loopback_ex", "fanout")
+    await ch.queue_declare("loop_q", arguments={
+        "x-message-ttl": 50, "x-dead-letter-exchange": "loopback_ex"})
+    await ch.queue_bind("loop_q", "loopback_ex", "")
+    ch.basic_publish(b"once-around", routing_key="loop_q")
+    await asyncio.sleep(1.0)  # several sweep + TTL cycles
+    # first expiry forwarded it back to loop_q (x-death count 1); there it
+    # re-queued WITHOUT expiration... but queue TTL still applies, so the
+    # second expiry sees the (loop_q, expired) entry and drops it
+    ok = await ch.queue_declare("loop_q", passive=True)
+    assert ok.message_count == 0
+    assert server.broker.metrics.dead_lettered_msgs == 1
+
+
+async def test_dlx_to_missing_exchange_drops(client):
+    ch = await client.channel()
+    await ch.queue_declare("noex_q", arguments={
+        "x-max-length": 0, "x-dead-letter-exchange": "ghost_ex"})
+    ch.basic_publish(b"void", routing_key="noex_q")
+    await asyncio.sleep(0.1)
+    ok = await ch.queue_declare("noex_q", passive=True)
+    assert ok.message_count == 0  # dropped, broker healthy
+    ch.basic_publish(b"still-works", routing_key="noex_q")
+    await asyncio.sleep(0.05)
+
+
+# -- x-expires -------------------------------------------------------------
+
+
+async def test_queue_idle_expiry(client):
+    ch = await client.channel()
+    await ch.queue_declare("idle_q", arguments={"x-expires": 300})
+    ch.basic_publish(b"x", routing_key="idle_q")
+    await asyncio.sleep(1.0)  # > x-expires + sweep interval
+    with pytest.raises(ChannelClosedError) as exc_info:
+        await ch.queue_declare("idle_q", passive=True)
+    assert exc_info.value.reply_code == 404
+
+
+async def test_queue_with_consumer_does_not_idle_expire(client):
+    ch = await client.channel()
+    await ch.queue_declare("busy_q", arguments={"x-expires": 300})
+    await ch.basic_consume("busy_q", lambda m: None)
+    await asyncio.sleep(1.0)
+    ok = await ch.queue_declare("busy_q", passive=True)
+    assert ok.queue == "busy_q"  # alive: consumer pins it
+
+
+async def test_use_resets_idle_clock(client):
+    ch = await client.channel()
+    await ch.queue_declare("pinged_q", arguments={"x-expires": 600})
+    for _ in range(4):
+        await asyncio.sleep(0.3)
+        await ch.basic_get("pinged_q")  # use resets the clock
+    ok = await ch.queue_declare("pinged_q", passive=True)
+    assert ok.queue == "pinged_q"
+
+
+# -- validation ------------------------------------------------------------
+
+
+async def test_invalid_arguments_rejected(client):
+    cases = [
+        {"x-max-length": -1},
+        {"x-max-length-bytes": "big"},
+        {"x-expires": 0},
+        {"x-dead-letter-exchange": 7},
+        {"x-dead-letter-routing-key": "rk"},  # without x-dead-letter-exchange
+        {"x-overflow": "reject-publish"},
+    ]
+    for args in cases:
+        ch = await client.channel()
+        with pytest.raises(ChannelClosedError) as exc_info:
+            await ch.queue_declare("bad_q", arguments=args)
+        assert exc_info.value.reply_code == 406, args
+
+
+async def test_retry_topology_survives_multiple_passes(client):
+    """Work queue -> TTL retry queue -> work queue: a history containing an
+    explicit reject is a client-driven retry loop and must keep flowing
+    (only FULLY automatic cycles are suppressed)."""
+    ch = await client.channel()
+    await ch.exchange_declare("work_dlx", "fanout")
+    await ch.exchange_declare("retry_dlx", "fanout")
+    await ch.queue_declare("work_q", arguments={
+        "x-dead-letter-exchange": "work_dlx"})
+    await ch.queue_declare("retry_q", arguments={
+        "x-message-ttl": 60, "x-dead-letter-exchange": "retry_dlx"})
+    await ch.queue_bind("retry_q", "work_dlx", "")
+    await ch.queue_bind("work_q", "retry_dlx", "")
+
+    ch.basic_publish(b"job", routing_key="work_q")
+    for attempt in (1, 2, 3):
+        msg = await (await drain_one(ch, "work_q", timeout=5.0))
+        assert msg is not None, f"retry attempt {attempt} never redelivered"
+        ch.basic_reject(msg.delivery_tag, requeue=False)
+    # after 3 rejects the job has cycled work->retry->work 3 times; the
+    # x-death history shows both the rejects and the retry-queue expiries
+    msg = await (await drain_one(ch, "work_q", timeout=5.0))
+    assert msg is not None
+    deaths = {(d["queue"], d["reason"]): d["count"]
+              for d in msg.properties.headers["x-death"]}
+    assert deaths[("work_q", "rejected")] == 3
+    assert deaths[("retry_q", "expired")] == 3
