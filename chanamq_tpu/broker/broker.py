@@ -510,11 +510,8 @@ class Broker:
             existing.touch()
             return existing
         arguments = arguments or {}
-        ttl_ms = arguments.get("x-message-ttl")
-        if ttl_ms is not None and (not isinstance(ttl_ms, int) or ttl_ms < 0):
-            raise BrokerError(
-                ErrorCode.PRECONDITION_FAILED, "invalid x-message-ttl")
         self._validate_queue_args(arguments)
+        ttl_ms = arguments.get("x-message-ttl")
         queue = Queue(
             self, vhost_name, name, durable=durable,
             exclusive_owner=exclusive_owner, auto_delete=auto_delete,
@@ -592,7 +589,7 @@ class Broker:
         """Queue-argument extensions (beyond the reference's x-message-ttl):
         dead-letter routing, length/byte caps, idle expiry. Invalid values
         fail the declare with PRECONDITION_FAILED, RabbitMQ-style."""
-        for arg_name in ("x-max-length", "x-max-length-bytes"):
+        for arg_name in ("x-message-ttl", "x-max-length", "x-max-length-bytes"):
             v = arguments.get(arg_name)
             if v is not None and (not isinstance(v, int) or v < 0):
                 raise BrokerError(
@@ -781,14 +778,26 @@ class Broker:
                 "kind": "queue.deleted", "vhost": vhost.name, "name": queue.name})
         return count
 
-    def schedule_queue_delete(self, vhost_name: str, queue_name: str) -> None:
-        """Auto-delete path from sync contexts (consumer cancel)."""
+    def schedule_queue_delete(
+        self, vhost_name: str, queue_name: str, *, only_if_idle: bool = False
+    ) -> None:
+        """Auto-delete path from sync contexts (consumer cancel). With
+        only_if_idle (the x-expires sweep), idleness is RE-CHECKED inside
+        the task: a consumer attached or a declare/get processed between
+        the sweep decision and this task running rescues the queue."""
 
         async def _delete() -> None:
             try:
                 vhost = self.vhosts.get(vhost_name)
-                if vhost and queue_name in vhost.queues:
-                    await self._remove_queue(vhost, vhost.queues[queue_name])
+                if not vhost or queue_name not in vhost.queues:
+                    return
+                queue = vhost.queues[queue_name]
+                if only_if_idle and (
+                    not queue.expires_ms or queue.consumers
+                    or now_ms() - queue.last_used < queue.expires_ms
+                ):
+                    return
+                await self._remove_queue(vhost, queue)
             except Exception:
                 log.exception("auto-delete of queue %s failed", queue_name)
 
@@ -1198,6 +1207,7 @@ class Broker:
                 for queue in expired_queues:
                     log.info("queue %s idle-expired (x-expires=%dms)",
                              queue.name, queue.expires_ms)
-                    self.schedule_queue_delete(queue.vhost, queue.name)
+                    self.schedule_queue_delete(
+                        queue.vhost, queue.name, only_if_idle=True)
         except asyncio.CancelledError:
             pass

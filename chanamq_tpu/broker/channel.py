@@ -69,8 +69,11 @@ class Consumer:
         return self.channel.deliver(self, queue, qm)
 
     def detach(self) -> None:
-        """Called when the queue is deleted under this consumer."""
+        """Called when the queue is deleted under this consumer: deregister
+        and notify the client with a server-sent Basic.Cancel if it asked
+        for consumer_cancel_notify."""
         self.channel.consumers.pop(self.tag, None)
+        self.channel.connection.notify_consumer_cancel(self.channel, self.tag)
 
     def can_take(self, next_size: int) -> bool:
         """Prefetch/QoS admission (reference: FrameStage.scala:387-392 +

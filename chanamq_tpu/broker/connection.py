@@ -55,7 +55,7 @@ SERVER_PROPERTIES = {
     "capabilities": {
         "publisher_confirms": True,
         "basic.nack": True,
-        "consumer_cancel_notify": False,
+        "consumer_cancel_notify": True,
         "exchange_exchange_bindings": True,
     },
 }
@@ -185,6 +185,9 @@ class AMQPConnection:
         # client announced capabilities.connection.blocked in start-ok:
         # it wants Connection.Blocked/Unblocked notifications
         self._supports_blocked = False
+        # capabilities.consumer_cancel_notify: the client wants a server-
+        # sent Basic.Cancel when a queue dies under its consumer
+        self._supports_cancel_notify = False
         # frames the current _fused_publish covered (so _consume_scan's
         # soft-error handlers resume past the failed publish's frames)
         self._fused_skip = 0
@@ -279,6 +282,16 @@ class AMQPConnection:
 
     def _has_consumers(self) -> bool:
         return any(ch.consumers for ch in self.channels.values())
+
+    def notify_consumer_cancel(self, channel: ServerChannel, tag: str) -> None:
+        """Server-sent Basic.Cancel: the queue died under this consumer
+        (delete / auto-delete / exclusive death / idle expiry). Sent only
+        to clients that announced the consumer_cancel_notify capability
+        (RabbitMQ extension; EXCEEDS the reference, which never cancels)."""
+        if (self._supports_cancel_notify and not self.closing
+                and not channel.closed):
+            self.send_method(channel.id, am.Basic.Cancel(
+                consumer_tag=tag, nowait=True))
 
     async def _read_chunk(self) -> bytes:
         # large reads amortize event-loop wakeups and process context
@@ -782,6 +795,8 @@ class AMQPConnection:
             if isinstance(capabilities, dict):
                 self._supports_blocked = bool(
                     capabilities.get("connection.blocked"))
+                self._supports_cancel_notify = bool(
+                    capabilities.get("consumer_cancel_notify"))
             self.send_method(0, am.Connection.Tune(
                 channel_max=self.cfg_channel_max,
                 frame_max=self.cfg_frame_max,

@@ -325,8 +325,14 @@ class Queue:
     def _settle_dead(self, qm: QueuedMessage, reason: str) -> None:
         """A message died in this queue (expired / rejected / overflowed):
         forward to the dead-letter exchange when configured, else release
-        the reference."""
-        if self.dlx and not qm.dead:
+        the reference. `is not None` matters: DLX "" (the default exchange,
+        routing straight to a queue named by x-dead-letter-routing-key) is
+        a legal RabbitMQ pattern."""
+        if self.dlx is not None and not qm.dead:
+            # settled from this queue's perspective: hydration and
+            # passivated-deque pruning must skip it even while the async
+            # dead-letter publish still holds the message reference
+            qm.dead = True
             self.broker.dead_letter(self, qm, reason)
         else:
             self.broker.unrefer(qm.message)

@@ -228,7 +228,8 @@ class AMQPClient:
             client_properties=client_properties or {
                 "product": "chanamq-tpu-client",
                 # opt in to Connection.Blocked/Unblocked notifications
-                "capabilities": {"connection.blocked": True},
+                "capabilities": {"connection.blocked": True,
+                                 "consumer_cancel_notify": True},
             },
             mechanism=mech.decode(), response=response, locale="en_US",
         ))
@@ -562,6 +563,8 @@ class ClientChannel:
         # deliveries racing the consume-ok -> registration gap are buffered
         self._pending_deliveries: dict[str, list[DeliveredMessage]] = {}
         self.returns: list[ReturnedMessage] = []
+        # consumer tags the SERVER cancelled (queue died under them)
+        self.cancelled_consumers: list[str] = []
         # confirm mode
         self.confirm_mode = False
         self._publish_seq = 0
@@ -619,6 +622,15 @@ class ClientChannel:
             else:
                 self._pending_deliveries.setdefault(
                     method.consumer_tag, []).append(msg)
+            return
+        if isinstance(method, am.Basic.Cancel):
+            # server-sent cancel: the queue died under this consumer
+            # (consumer_cancel_notify capability)
+            self._consumers.pop(method.consumer_tag, None)
+            self.cancelled_consumers.append(method.consumer_tag)
+            if not method.nowait:
+                self.client._send_method(self.id, am.Basic.CancelOk(
+                    consumer_tag=method.consumer_tag))
             return
         if isinstance(method, am.Basic.Return):
             self.returns.append(ReturnedMessage(

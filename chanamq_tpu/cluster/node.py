@@ -397,6 +397,7 @@ class ClusterNode:
         rpc.register("consumer.deliver", self._h_consumer_deliver)
         rpc.register("consumer.deliver_many", self._h_consumer_deliver_many)
         rpc.register("consumer.credit", self._h_consumer_credit)
+        rpc.register("consumer.cancelled", self._h_consumer_cancelled)
 
     # ------------------------------------------------------------------
     # metadata replication
@@ -914,6 +915,33 @@ class ClusterNode:
         channel.consumers[tag] = stub
         return ref
 
+    def notify_remote_cancel_bg(
+        self, origin: str, vhost: str, name: str, tag: str
+    ) -> None:
+        """Fire-and-forget consumer-cancelled event toward the origin node
+        (owner-side queue death under a remote consumer)."""
+
+        async def _notify() -> None:
+            try:
+                await self._event(origin, "consumer.cancelled", {
+                    "vhost": vhost, "queue": name, "tag": tag})
+            except Exception:
+                log.debug("consumer.cancelled to %s dropped", origin)
+
+        asyncio.get_event_loop().create_task(_notify())
+
+    async def _h_consumer_cancelled(self, payload: dict) -> dict:
+        """Origin-side: the owner cancelled our remote consumer (its queue
+        died). Deregister the stub and notify the client."""
+        key = (str(payload["vhost"]), str(payload["queue"]),
+               str(payload["tag"]))
+        info = self._remote_consumers.pop(key, None)
+        if info is not None:
+            channel = info["channel"]
+            channel.consumers.pop(key[2], None)
+            channel.connection.notify_consumer_cancel(channel, key[2])
+        return {}
+
     async def remote_cancel(self, vhost: str, name: str, tag: str) -> None:
         info = self._remote_consumers.pop((vhost, name, tag), None)
         if info is None:
@@ -1052,7 +1080,11 @@ class RemoteConsumer:
             }))
 
     def detach(self) -> None:
-        pass
+        """The owner's queue died under this remote consumer: tell the
+        origin node so it can deregister the stub and send the client a
+        Basic.Cancel (consumer_cancel_notify)."""
+        self.cluster.notify_remote_cancel_bg(
+            self.origin, self.queue.vhost, self.queue.name, self.tag)
 
     def requeue_outstanding(self) -> None:
         for offset in sorted(self.outstanding_offsets):

@@ -462,3 +462,43 @@ async def test_vhost_isolation(server):
         await chb.exchange_declare("iso_ex", "fanout", passive=True)
     await ca.close()
     await cb.close()
+
+
+async def test_consumer_cancel_notify_on_queue_delete(client):
+    """Deleting a queue under a live consumer sends a server-side
+    Basic.Cancel to clients that announced consumer_cancel_notify
+    (RabbitMQ extension; the reference never cancels)."""
+    assert client.server_properties["capabilities"]["consumer_cancel_notify"]
+    ch = await client.channel()
+    await ch.queue_declare("ccn_q")
+    tag = await ch.basic_consume("ccn_q", lambda m: None)
+    ch2 = await client.channel()
+    await ch2.queue_delete("ccn_q")
+    for _ in range(50):
+        if ch.cancelled_consumers:
+            break
+        await asyncio.sleep(0.02)
+    assert ch.cancelled_consumers == [tag]
+
+
+async def test_consumer_cancel_notify_across_connections(server):
+    """The cancel notification reaches a consumer on a DIFFERENT connection
+    than the one deleting the queue."""
+    from chanamq_tpu.client import AMQPClient as _C
+
+    c1 = await _C.connect("127.0.0.1", server.bound_port)
+    c2 = await _C.connect("127.0.0.1", server.bound_port)
+    try:
+        ch1 = await c1.channel()
+        await ch1.queue_declare("ccn2_q")
+        tag = await ch1.basic_consume("ccn2_q", lambda m: None)
+        ch2 = await c2.channel()
+        await ch2.queue_delete("ccn2_q")
+        for _ in range(50):
+            if ch1.cancelled_consumers:
+                break
+            await asyncio.sleep(0.02)
+        assert ch1.cancelled_consumers == [tag]
+    finally:
+        await c1.close()
+        await c2.close()

@@ -791,3 +791,38 @@ async def test_exchange_to_exchange_binds_replicated(tmp_path):
     finally:
         for node in nodes:
             await node.stop()
+
+
+async def test_remote_consumer_cancel_notify_on_queue_delete(tmp_path):
+    """Owner-side queue death under a remote consumer propagates a
+    consumer.cancelled event to the origin, which deregisters the stub and
+    sends the client a Basic.Cancel."""
+    nodes = await start_cluster(tmp_path, 2)
+    try:
+        # find a queue name owned by node 1 so node 0 consumes remotely
+        name = None
+        for i in range(100):
+            cand = f"rccn_q{i}"
+            if nodes[0].cluster.queue_owner("/", cand) == nodes[1].name:
+                name = cand
+                break
+        assert name is not None
+        c0 = await AMQPClient.connect("127.0.0.1", nodes[0].port)
+        ch0 = await c0.channel()
+        await ch0.queue_declare(name, durable=True)
+        tag = await ch0.basic_consume(name, lambda m: None)
+        await asyncio.sleep(0.2)
+        # delete via the owner node directly
+        c1 = await AMQPClient.connect("127.0.0.1", nodes[1].port)
+        ch1 = await c1.channel()
+        await ch1.queue_delete(name)
+        for _ in range(100):
+            if ch0.cancelled_consumers:
+                break
+            await asyncio.sleep(0.02)
+        assert ch0.cancelled_consumers == [tag]
+        await c0.close()
+        await c1.close()
+    finally:
+        for node in nodes:
+            await node.stop()

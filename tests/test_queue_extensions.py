@@ -276,3 +276,19 @@ async def test_retry_topology_survives_multiple_passes(client):
               for d in msg.properties.headers["x-death"]}
     assert deaths[("work_q", "rejected")] == 3
     assert deaths[("retry_q", "expired")] == 3
+
+
+async def test_dlx_default_exchange_routes_to_named_queue(client):
+    """x-dead-letter-exchange \"\" with a routing key is the standard
+    RabbitMQ pattern for dead-lettering straight into a named queue via
+    the default exchange."""
+    ch = await client.channel()
+    await ch.queue_declare("direct_dlq")
+    await ch.queue_declare("dd_q", arguments={
+        "x-dead-letter-exchange": "",
+        "x-dead-letter-routing-key": "direct_dlq",
+        "x-max-length": 0})
+    ch.basic_publish(b"straight", routing_key="dd_q")
+    got = await drain(ch, "direct_dlq", 1)
+    assert [m.body for m in got] == [b"straight"]
+    assert got[0].properties.headers["x-death"][0]["reason"] == "maxlen"
