@@ -126,6 +126,7 @@ class AMQPConnection:
         frame_max: int = 131072,
         channel_max: int = 2047,
         max_message_size: int = 128 * 1024 * 1024,
+        users: Optional[dict[str, str]] = None,
     ) -> None:
         self.broker = broker
         self.reader = reader
@@ -140,6 +141,8 @@ class AMQPConnection:
         self.frame_max = frame_max
         self.channel_max = channel_max
 
+        self.users = users  # None: accept anything (reference parity)
+        self.username: Optional[str] = None
         self.vhost_name: str = ""
         self.channels: dict[int, ServerChannel] = {}
         # channels we soft-closed: frames on them are discarded until the
@@ -848,14 +851,37 @@ class AMQPConnection:
                 method.CLASS_ID, method.METHOD_ID)
 
     def _authenticate(self, mechanism: str, response: bytes) -> bool:
-        """SASL (reference: SaslMechanism.scala:6-98 — PLAIN parses
-        user/password but verifies nothing; auth is unimplemented there too,
-        README 'Status'). A pluggable authenticator can tighten this."""
+        """SASL. Without configured users this matches the reference
+        (SaslMechanism.scala:6-98 — PLAIN parses user/password but verifies
+        nothing; auth unimplemented there, README 'Status'). With
+        chana.mq.auth.users configured, PLAIN verifies against the user
+        table in constant time and EXTERNAL is refused (EXCEEDS the
+        reference)."""
         if mechanism == "PLAIN":
             parts = response.split(b"\x00")
-            return len(parts) == 3
+            if len(parts) != 3:
+                return False
+            if self.users is None:
+                return True
+            import hmac
+
+            try:
+                user = parts[1].decode("utf-8")
+                password = parts[2].decode("utf-8")
+            except UnicodeDecodeError:
+                return False
+            expected = self.users.get(user)
+            # compare even for unknown users so a timing probe can't
+            # enumerate the user table
+            ok = hmac.compare_digest(
+                (expected if expected is not None else "\x00").encode(),
+                password.encode())
+            if ok and expected is not None:
+                self.username = user
+                return True
+            return False
         if mechanism == "EXTERNAL":
-            return True
+            return self.users is None
         return False
 
     # -- channel class -----------------------------------------------------

@@ -39,6 +39,7 @@ class BrokerServer:
         max_connections: int = 0,
         backlog: int = 128,
         max_message_size: int = 128 * 1024 * 1024,
+        users: "Optional[dict[str, str]]" = None,
     ) -> None:
         self.broker = broker or Broker(store=store)
         self.host = host
@@ -52,6 +53,12 @@ class BrokerServer:
         # max-connections / backlog, Settings.scala:141-219); 0 = uncapped
         self.max_connections = max_connections
         self.backlog = backlog
+        # optional SASL PLAIN verification: user -> password. None/empty
+        # keeps the reference's behavior (parse but never verify,
+        # SaslMechanism.scala:49-76); configuring users turns real
+        # authentication on (EXCEEDS the reference, README "Status": auth
+        # unimplemented there).
+        self.users = users or None
         self.max_message_size = max_message_size
         self.refused_connections = 0
         self._servers: list[asyncio.AbstractServer] = []
@@ -107,6 +114,7 @@ class BrokerServer:
             heartbeat_s=self.heartbeat_s, frame_max=self.frame_max,
             channel_max=self.channel_max,
             max_message_size=self.max_message_size,
+            users=self.users,
         )
         self._connections.add(connection)
         try:
@@ -197,7 +205,25 @@ class BrokerServer:
             backlog=config.int("chana.mq.server.backlog") or 128,
             max_message_size=config.size_bytes("chana.mq.message.max-size")
             or 0,
+            users=cls._config_users(config),
         )
+
+    @staticmethod
+    def _config_users(config) -> Optional[dict]:
+        """chana.mq.auth.users, validated fail-closed: a non-mapping value
+        (malformed file/env) must error out, never silently disable auth."""
+        users = config.get("chana.mq.auth.users")
+        if users is None or users == {}:
+            return None
+        if not isinstance(users, dict) or not all(
+            isinstance(k, str) and isinstance(v, str)
+            for k, v in users.items()
+        ):
+            from ..config import ConfigError
+
+            raise ConfigError(
+                "chana.mq.auth.users must map user names to passwords")
+        return users
 
 
 async def run_node(config) -> None:

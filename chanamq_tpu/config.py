@@ -48,6 +48,10 @@ DEFAULTS: dict[str, Any] = {
     # unaffected. 0 disables the cap.
     "chana.mq.server.max-connections": 1024,
     "chana.mq.server.backlog": 128,
+    # optional SASL PLAIN verification: {"user": "password", ...}. Empty
+    # disables verification (the reference parses but never verifies,
+    # SaslMechanism.scala:49-76); configuring users also refuses EXTERNAL.
+    "chana.mq.auth.users": None,
     "chana.mq.internal.timeout": "20s",
     "chana.mq.message.inactive": "1h",
     "chana.mq.message.sweep-interval": "1s",
@@ -129,11 +133,17 @@ def _env_key(path: str) -> str:
     return "CHANAMQ_" + trimmed.replace(".", "_").replace("-", "_").upper()
 
 
+# keys whose VALUE is a mapping: flattening stops here so a config file's
+# {"auth": {"users": {...}}} arrives as one dict, not per-user leaf keys
+_DICT_LEAF_KEYS = frozenset({"chana.mq.auth.users"})
+
+
 def _flatten(tree: Mapping[str, Any], prefix: str = "") -> dict[str, Any]:
     flat: dict[str, Any] = {}
     for key, value in tree.items():
         path = f"{prefix}.{key}" if prefix else key
-        if isinstance(value, Mapping):
+        full = path if path.startswith("chana.") else f"chana.mq.{path}"
+        if isinstance(value, Mapping) and full not in _DICT_LEAF_KEYS:
             flat.update(_flatten(value, path))
         else:
             flat[path] = value
@@ -163,7 +173,21 @@ class Config:
         for path in list(self._values):
             env_value = env.get(_env_key(path))
             if env_value is not None:
-                self._values[path] = _coerce(env_value, self._values[path])
+                if path in _DICT_LEAF_KEYS:
+                    # dict-valued key from the environment: JSON only
+                    # (e.g. CHANAMQ_AUTH_USERS='{"alice": "pw"}')
+                    try:
+                        parsed = json.loads(env_value)
+                    except json.JSONDecodeError as exc:
+                        raise ConfigError(
+                            f"{_env_key(path)} must be a JSON object: {exc}"
+                        ) from None
+                    if not isinstance(parsed, dict):
+                        raise ConfigError(
+                            f"{_env_key(path)} must be a JSON object")
+                    self._values[path] = parsed
+                else:
+                    self._values[path] = _coerce(env_value, self._values[path])
         if overrides:
             for key, value in overrides.items():
                 full = key if key.startswith("chana.") else f"chana.mq.{key}"

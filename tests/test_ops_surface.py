@@ -360,3 +360,91 @@ async def test_sigterm_graceful_drain(tmp_path):
     finally:
         p.terminate()
         p.wait(timeout=10)
+
+
+async def test_plain_auth_verifies_when_users_configured():
+    """chana.mq.auth.users turns SASL PLAIN verification on (the reference
+    parses credentials but never verifies; auth listed unimplemented in its
+    README). Wrong password or unknown user -> ACCESS_REFUSED close;
+    EXTERNAL is refused while a user table is set."""
+    from chanamq_tpu.broker.server import BrokerServer
+    from chanamq_tpu.client import AMQPClient
+    from chanamq_tpu.client.client import ConnectionClosedError
+
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                       users={"alice": "s3cret"})
+    await srv.start()
+    try:
+        c = await AMQPClient.connect("127.0.0.1", srv.bound_port,
+                                     username="alice", password="s3cret")
+        ch = await c.channel()
+        await ch.queue_declare("authed_q")
+        await c.close()
+
+        for user, pw in (("alice", "wrong"), ("mallory", "s3cret")):
+            with pytest.raises((ConnectionClosedError, OSError,
+                                asyncio.IncompleteReadError,
+                                asyncio.TimeoutError)):
+                await AMQPClient.connect("127.0.0.1", srv.bound_port,
+                                         username=user, password=pw)
+    finally:
+        await srv.stop()
+
+
+async def test_auth_disabled_accepts_anything():
+    from chanamq_tpu.broker.server import BrokerServer
+    from chanamq_tpu.client import AMQPClient
+
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    try:
+        c = await AMQPClient.connect("127.0.0.1", srv.bound_port,
+                                     username="anyone", password="anything")
+        await c.close()
+    finally:
+        await srv.stop()
+
+
+async def test_auth_users_from_config_file_and_env(tmp_path):
+    """Dict-valued chana.mq.auth.users survives BOTH config layers: a JSON
+    config file (flattening stops at the users mapping) and a JSON-object
+    environment variable. Malformed values fail the boot, never fail open."""
+    import json as _json
+
+    from chanamq_tpu.config import Config, ConfigError
+    from chanamq_tpu.broker.server import BrokerServer
+    from chanamq_tpu.client import AMQPClient
+    from chanamq_tpu.client.client import ConnectionClosedError
+
+    cfg_file = tmp_path / "broker.json"
+    cfg_file.write_text(_json.dumps(
+        {"auth": {"users": {"bob": "pw1"}},
+         "amqp": {"interface": "127.0.0.1", "port": 0,
+                  "connection": {"heartbeat": "0s"}}}))
+    cfg = Config(file=str(cfg_file), env={})
+    assert cfg.get("chana.mq.auth.users") == {"bob": "pw1"}
+    srv = BrokerServer.from_config(cfg)
+    await srv.start()
+    try:
+        c = await AMQPClient.connect("127.0.0.1", srv.bound_port,
+                                     username="bob", password="pw1")
+        await c.close()
+        with pytest.raises((ConnectionClosedError, OSError,
+                            asyncio.IncompleteReadError,
+                            asyncio.TimeoutError)):
+            await AMQPClient.connect("127.0.0.1", srv.bound_port,
+                                     username="bob", password="nope")
+    finally:
+        await srv.stop()
+
+    # env layer: JSON object required
+    cfg2 = Config(env={"CHANAMQ_AUTH_USERS": '{"eve": "pw2"}'})
+    assert cfg2.get("chana.mq.auth.users") == {"eve": "pw2"}
+    with pytest.raises(ConfigError):
+        Config(env={"CHANAMQ_AUTH_USERS": "not-json"})
+    with pytest.raises(ConfigError):
+        Config(env={"CHANAMQ_AUTH_USERS": '["list"]'})
+    # fail-closed on a malformed override too
+    with pytest.raises(ConfigError):
+        BrokerServer.from_config(
+            Config(overrides={"chana.mq.auth.users": "alice:pw"}, env={}))
