@@ -18,4 +18,4 @@ Layer map (mirrors SURVEY.md §1):
   chanamq_tpu.models/ops/parallel — auxiliary JAX analytics (off the message path)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"

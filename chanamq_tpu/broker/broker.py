@@ -21,6 +21,7 @@ from typing import Any, Awaitable, Optional
 
 from ..amqp.constants import ErrorCode, ExchangeType
 from ..amqp.properties import BasicProperties
+from ..amqp.value_codec import Timestamp
 from ..cluster.idgen import IdGenerator
 from ..store.api import StoredExchange, StoredMessage, StoredQueue, StoreService
 from ..store.memory import MemoryStore
@@ -835,6 +836,9 @@ class Broker:
                 self.unrefer(msg)
                 return
             entry["count"] = int(entry.get("count", 1)) + 1
+            # re-stamp on every death (RabbitMQ reports the LATEST death
+            # time; retry-backoff consumers read x-death[0]["time"])
+            entry["time"] = Timestamp(now_ms() // 1000)
             deaths.remove(entry)
             deaths.insert(0, entry)
         else:
@@ -843,6 +847,8 @@ class Broker:
                 "exchange": msg.exchange,
                 "routing-keys": [msg.routing_key],
                 "count": 1,
+                # Timestamp subclass -> wire tag 'T', matching RabbitMQ
+                "time": Timestamp(now_ms() // 1000),
             })
         headers["x-death"] = deaths
         headers.setdefault("x-first-death-queue", queue.name)

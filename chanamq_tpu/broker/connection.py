@@ -48,9 +48,11 @@ from .channel import ChannelMode, Consumer, ServerChannel
 
 log = logging.getLogger("chanamq.connection")
 
+from .. import __version__
+
 SERVER_PROPERTIES = {
     "product": "chanamq-tpu",
-    "version": "0.1.0",
+    "version": __version__,
     "platform": "Python/asyncio",
     "capabilities": {
         "publisher_confirms": True,

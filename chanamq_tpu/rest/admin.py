@@ -147,9 +147,11 @@ class AdminServer:
                 "exclusive": queue.exclusive_owner is not None,
                 "auto_delete": queue.auto_delete,
                 "messages": queue.message_count,
+                "ready_bytes": queue.ready_bytes,
                 "unacked": len(queue.outstanding),
                 "consumers": queue.consumer_count,
                 "ttl_ms": queue.ttl_ms,
+                "arguments": queue.arguments or {},
             }
             for queue in vhost.queues.values()
         ]
