@@ -826,3 +826,45 @@ async def test_remote_consumer_cancel_notify_on_queue_delete(tmp_path):
     finally:
         for node in nodes:
             await node.stop()
+
+
+async def test_tx_commit_over_remotely_owned_queue(tmp_path):
+    """tx.commit replays publishes into remotely-owned queues through the
+    pipelined push path and CommitOk arrives only after the owner accepted
+    them (strict barrier)."""
+    nodes = await start_cluster(tmp_path, 2)
+    try:
+        name = None
+        for i in range(100):
+            cand = f"txc_q{i}"
+            if nodes[0].cluster.queue_owner("/", cand) == nodes[1].name:
+                name = cand
+                break
+        assert name is not None
+        c0 = await AMQPClient.connect("127.0.0.1", nodes[0].port)
+        ch0 = await c0.channel()
+        await ch0.queue_declare(name, durable=True)
+        await ch0.tx_select()
+        for i in range(20):
+            ch0.basic_publish(b"tx%02d" % i, routing_key=name,
+                              properties=PERSISTENT)
+        # buffered: owner sees nothing yet
+        c1 = await AMQPClient.connect("127.0.0.1", nodes[1].port)
+        ch1 = await c1.channel()
+        ok = await ch1.queue_declare(name, passive=True)
+        assert ok.message_count == 0
+        await ch0.tx_commit()
+        ok = await ch1.queue_declare(name, passive=True)
+        assert ok.message_count == 20
+        # rollback path drops cleanly too
+        ch0.basic_publish(b"never", routing_key=name, properties=PERSISTENT)
+        await ch0.tx_rollback()
+        ok = await ch1.queue_declare(name, passive=True)
+        assert ok.message_count == 20
+        got = await ch1.basic_get(name, no_ack=True)
+        assert got is not None and got.body == b"tx00"
+        await c0.close()
+        await c1.close()
+    finally:
+        for node in nodes:
+            await node.stop()
