@@ -424,3 +424,101 @@ async def test_golden_wire_confirms_and_mandatory_return():
         except Exception:
             pass
         await srv.stop()
+
+
+async def test_golden_wire_tx_and_exchange_bind():
+    """tx and exchange-to-exchange-bind wire shapes, spec-rule bytes only:
+    tx.select/commit/rollback-ok frames (class 90), exchange.bind-ok
+    (40,31) and the spec-quirk exchange.unbind-ok method id 51 (not 41),
+    commit visibility through an e2e hop via byte-exact basic.get-ok /
+    get-empty responses."""
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    reader, writer = await asyncio.open_connection("127.0.0.1", srv.bound_port)
+    try:
+        await handshake(reader, writer)
+        no_bits = b"\x00"
+        # exchange.declare src(direct) + dst(fanout); queue gx.q bound to dst
+        writer.write(method_frame(1, 40, 10,
+            struct.pack(">H", 0) + shortstr("gx.src") + shortstr("direct")
+            + no_bits + table()))
+        await expect_bytes(reader, method_frame(1, 40, 11, b""),
+                           "exchange.declare-ok (src)")
+        writer.write(method_frame(1, 40, 10,
+            struct.pack(">H", 0) + shortstr("gx.dst") + shortstr("fanout")
+            + no_bits + table()))
+        await expect_bytes(reader, method_frame(1, 40, 11, b""),
+                           "exchange.declare-ok (dst)")
+        writer.write(method_frame(1, 50, 10,
+            struct.pack(">H", 0) + shortstr("gx.q") + no_bits + table()))
+        await read_frame(reader)  # queue.declare-ok (counts vary)
+        writer.write(method_frame(1, 50, 20,
+            struct.pack(">H", 0) + shortstr("gx.q") + shortstr("gx.dst")
+            + shortstr("") + no_bits + table()))
+        await expect_bytes(reader, method_frame(1, 50, 21, b""),
+                           "queue.bind-ok")
+
+        # exchange.bind dst <- src on key "k" -> bind-ok (40,31) byte-exact
+        writer.write(method_frame(1, 40, 30,
+            struct.pack(">H", 0) + shortstr("gx.dst") + shortstr("gx.src")
+            + shortstr("k") + no_bits + table()))
+        await expect_bytes(reader, method_frame(1, 40, 31, b""),
+                           "exchange.bind-ok")
+
+        # tx.select -> select-ok (90,10 -> 90,11)
+        writer.write(method_frame(1, 90, 10, b""))
+        await expect_bytes(reader, method_frame(1, 90, 11, b""),
+                           "tx.select-ok")
+
+        # a buffered publish is invisible before commit: get-empty
+        publish = (
+            method_frame(1, 60, 40,
+                struct.pack(">H", 0) + shortstr("gx.src") + shortstr("k")
+                + no_bits)
+            + content_header_frame(1, len(BODY), 0x1000, bytes([1]))
+            + body_frame(1, BODY))
+        writer.write(publish)
+        get = method_frame(1, 60, 70,
+                           struct.pack(">H", 0) + shortstr("gx.q") + b"\x01")
+        writer.write(get)
+        await expect_bytes(reader,
+            method_frame(1, 60, 72, shortstr("")), "get-empty before commit")
+
+        # commit -> commit-ok, then the message is visible through the e2e
+        # hop: get-ok with server tag 1, exchange gx.src, key k, 0 remaining
+        writer.write(method_frame(1, 90, 20, b""))
+        await expect_bytes(reader, method_frame(1, 90, 21, b""),
+                           "tx.commit-ok")
+        writer.write(get)
+        await expect_bytes(reader,
+            method_frame(1, 60, 71,
+                struct.pack(">Q", 1) + b"\x00" + shortstr("gx.src")
+                + shortstr("k") + struct.pack(">I", 0)),
+            "get-ok after commit")
+        await expect_bytes(reader,
+            content_header_frame(1, len(BODY), 0x1000, bytes([1])),
+            "got content header")
+        await expect_bytes(reader, body_frame(1, BODY), "got body")
+
+        # rollback discards: publish, rollback -> rollback-ok, get-empty
+        writer.write(publish)
+        writer.write(method_frame(1, 90, 30, b""))
+        await expect_bytes(reader, method_frame(1, 90, 31, b""),
+                           "tx.rollback-ok")
+        writer.write(get)
+        await expect_bytes(reader,
+            method_frame(1, 60, 72, shortstr("")), "get-empty after rollback")
+
+        # exchange.unbind -> unbind-ok with the spec-quirk method id 51
+        writer.write(method_frame(1, 40, 40,
+            struct.pack(">H", 0) + shortstr("gx.dst") + shortstr("gx.src")
+            + shortstr("k") + no_bits + table()))
+        await expect_bytes(reader, method_frame(1, 40, 51, b""),
+                           "exchange.unbind-ok (method id 51)")
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+        await srv.stop()
