@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Retry-topology demo using the broker's RabbitMQ-style extensions the
+reference never implemented: a capped work queue dead-letters failures into
+a TTL'd retry queue whose own DLX routes them back, jobs are submitted in a
+tx batch, and the consumer inspects x-death to give up after 3 attempts.
+
+Usage: python examples/work_queue_with_retry.py [host] [port]
+(defaults to a broker on 127.0.0.1:5672 — start one with
+`python -m chanamq_tpu.broker.server` or `chanamq-server`)
+"""
+
+import asyncio
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from chanamq_tpu.client import AMQPClient
+
+RETRY_DELAY_MS = 500
+MAX_ATTEMPTS = 3
+
+
+async def main() -> None:
+    host = sys.argv[1] if len(sys.argv) > 1 else "127.0.0.1"
+    port = int(sys.argv[2]) if len(sys.argv) > 2 else 5672
+    c = await AMQPClient.connect(host, port)
+    ch = await c.channel()
+
+    # work -> (reject) -> retry_ex -> retry queue -TTL-> work_ex -> work
+    await ch.exchange_declare("work_ex", "direct", durable=True)
+    await ch.exchange_declare("retry_ex", "direct", durable=True)
+    await ch.queue_declare("work", durable=True, arguments={
+        "x-dead-letter-exchange": "retry_ex",
+        "x-max-length": 10_000,
+    })
+    await ch.queue_bind("work", "work_ex", "job")
+    await ch.queue_declare("work.retry", durable=True, arguments={
+        "x-message-ttl": RETRY_DELAY_MS,
+        "x-dead-letter-exchange": "work_ex",
+    })
+    await ch.queue_bind("work.retry", "retry_ex", "job")
+
+    # submit a batch of jobs atomically: all-or-nothing via tx.commit
+    await ch.tx_select()
+    for i in range(5):
+        ch.basic_publish(b"job-%d" % i, exchange="work_ex",
+                         routing_key="job")
+    await ch.tx_commit()
+    print("submitted 5 jobs in one committed tx batch")
+
+    done = asyncio.get_event_loop().create_future()
+    seen: dict[bytes, int] = {}
+
+    def on_job(msg):
+        deaths = (msg.properties.headers or {}).get("x-death") or []
+        attempts = next((d["count"] for d in deaths
+                         if d.get("queue") == "work"
+                         and d.get("reason") == "rejected"), 0)
+        seen[msg.body] = attempts + 1
+        if msg.body == b"job-3" and attempts < MAX_ATTEMPTS - 1:
+            # simulate a failing job: reject -> retry queue -> redelivery
+            print(f"{msg.body.decode()}: attempt {attempts + 1} failed, "
+                  f"retrying in {RETRY_DELAY_MS}ms")
+            consume_ch.basic_reject(msg.delivery_tag, requeue=False)
+        else:
+            verb = "gave up on" if attempts else "processed"
+            print(f"{verb} {msg.body.decode()} "
+                  f"(attempt {attempts + 1})")
+            consume_ch.basic_ack(msg.delivery_tag)
+        if len(seen) == 5 and seen.get(b"job-3", 0) >= MAX_ATTEMPTS:
+            if not done.done():
+                done.set_result(None)
+
+    consume_ch = await c.channel()
+    await consume_ch.basic_qos(prefetch_count=16)
+    await consume_ch.basic_consume("work", on_job)
+    await asyncio.wait_for(done, timeout=30)
+    await c.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -292,3 +292,44 @@ async def test_dlx_default_exchange_routes_to_named_queue(client):
     got = await drain(ch, "direct_dlq", 1)
     assert [m.body for m in got] == [b"straight"]
     assert got[0].properties.headers["x-death"][0]["reason"] == "maxlen"
+
+
+async def test_queue_extension_arguments_survive_restart(tmp_path):
+    """Caps and DLX wiring on a durable queue are recovered from the store:
+    after a restart the max-length still drops to the DLX."""
+    from chanamq_tpu.store.sqlite import SqliteStore
+
+    db_path = str(tmp_path / "args.db")
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                       store=SqliteStore(db_path))
+    await srv.start()
+    try:
+        c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        ch = await c.channel()
+        await ch.exchange_declare("ra_dlx", "fanout", durable=True)
+        await ch.queue_declare("ra_dlq", durable=True)
+        await ch.queue_bind("ra_dlq", "ra_dlx", "")
+        await ch.queue_declare("ra_q", durable=True, arguments={
+            "x-max-length": 1, "x-dead-letter-exchange": "ra_dlx"})
+        await c.close()
+    finally:
+        await srv.stop()
+
+    srv2 = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                        store=SqliteStore(db_path))
+    await srv2.start()
+    try:
+        c2 = await AMQPClient.connect("127.0.0.1", srv2.bound_port)
+        ch2 = await c2.channel()
+        ch2.basic_publish(b"one", routing_key="ra_q",
+                          properties=BasicProperties(delivery_mode=2))
+        ch2.basic_publish(b"two", routing_key="ra_q",
+                          properties=BasicProperties(delivery_mode=2))
+        got = await drain(ch2, "ra_dlq", 1)
+        assert [m.body for m in got] == [b"one"]
+        assert got[0].properties.headers["x-death"][0]["reason"] == "maxlen"
+        ok = await ch2.queue_declare("ra_q", passive=True)
+        assert ok.message_count == 1
+        await c2.close()
+    finally:
+        await srv2.stop()
