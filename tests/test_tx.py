@@ -347,3 +347,49 @@ async def test_tx_buffered_publishes_count_against_memory_gauge(server, client):
     await ch.tx_rollback()
     await ch2.queue_declare("txq_mem", passive=True)
     assert broker.resident_bytes == before
+
+
+async def test_tx_commit_store_failure_never_sends_commit_ok(tmp_path):
+    """Tx.CommitOk is a durability barrier: a store failure covering the
+    commit's persistent writes must error the channel/connection instead of
+    acknowledging — and the message must not silently survive as a ghost."""
+    db_path = str(tmp_path / "txfail.db")
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                       store=SqliteStore(db_path))
+    await srv.start()
+    store = srv.broker.store
+    orig_insert = store.insert_message_nowait
+
+    def failing_insert(msg):
+        if msg.routing_key == "tx_fail_q":
+            store._submit_nowait(
+                lambda db: db.execute("INSERT INTO no_such_table VALUES (1)"))
+            return
+        orig_insert(msg)
+
+    store.insert_message_nowait = failing_insert
+    c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    ch = await c.channel()
+    await ch.queue_declare("tx_fail_q", durable=True)
+    await ch.tx_select()
+    ch.basic_publish(b"doomed", routing_key="tx_fail_q",
+                     properties=PERSISTENT)
+    with pytest.raises(Exception):
+        await ch.tx_commit()
+    store.insert_message_nowait = orig_insert
+    await c.close()
+    await srv.stop()
+
+    # after a restart, the failed commit left no durable ghost ready to
+    # deliver a message the client was told (nothing) about
+    srv2 = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                        store=SqliteStore(db_path))
+    await srv2.start()
+    try:
+        c2 = await AMQPClient.connect("127.0.0.1", srv2.bound_port)
+        ch2 = await c2.channel()
+        got = await ch2.basic_get("tx_fail_q", no_ack=True)
+        assert got is None
+        await c2.close()
+    finally:
+        await srv2.stop()
