@@ -129,6 +129,7 @@ class AMQPConnection:
         channel_max: int = 2047,
         max_message_size: int = 128 * 1024 * 1024,
         users: Optional[dict[str, str]] = None,
+        permissions: Optional[dict[str, list[str]]] = None,
     ) -> None:
         self.broker = broker
         self.reader = reader
@@ -144,6 +145,7 @@ class AMQPConnection:
         self.channel_max = channel_max
 
         self.users = users  # None: accept anything (reference parity)
+        self.permissions = permissions  # per-user vhost allowlists
         self.username: Optional[str] = None
         self.vhost_name: str = ""
         self.channels: dict[int, ServerChannel] = {}
@@ -828,6 +830,18 @@ class AMQPConnection:
             if not self._tuned:
                 raise HardError(ErrorCode.COMMAND_INVALID, "tune-ok required first")
             vhost_name = method.virtual_host or "/"
+            # allowlist BEFORE existence: a restricted user must not be
+            # able to use the error-code difference as a vhost-name oracle
+            if (self.permissions is not None and self.username is not None):
+                allowed = self.permissions.get(self.username)
+                # a user absent from the map is unrestricted (allowlists
+                # are opt-in per user)
+                if allowed is not None and vhost_name not in allowed:
+                    raise HardError(
+                        ErrorCode.ACCESS_REFUSED,
+                        f"user '{self.username}' may not access "
+                        f"vhost '{vhost_name}'",
+                        method.CLASS_ID, method.METHOD_ID)
             vhost = self.broker.vhosts.get(vhost_name)
             if vhost is None or not vhost.active:
                 raise HardError(

@@ -40,6 +40,7 @@ class BrokerServer:
         backlog: int = 128,
         max_message_size: int = 128 * 1024 * 1024,
         users: "Optional[dict[str, str]]" = None,
+        permissions: "Optional[dict[str, list[str]]]" = None,
     ) -> None:
         self.broker = broker or Broker(store=store)
         self.host = host
@@ -59,6 +60,9 @@ class BrokerServer:
         # authentication on (EXCEEDS the reference, README "Status": auth
         # unimplemented there).
         self.users = users or None
+        # per-user vhost allowlists (consulted only when users are set):
+        # a user listed here may open ONLY those vhosts
+        self.permissions = permissions or None
         self.max_message_size = max_message_size
         self.refused_connections = 0
         self._servers: list[asyncio.AbstractServer] = []
@@ -115,6 +119,7 @@ class BrokerServer:
             channel_max=self.channel_max,
             max_message_size=self.max_message_size,
             users=self.users,
+            permissions=self.permissions,
         )
         self._connections.add(connection)
         try:
@@ -180,6 +185,7 @@ class BrokerServer:
             ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ssl_context.load_cert_chain(certfile, keyfile)
             tls_port = config.int("chana.mq.amqp.amqps.port")
+        users = cls._config_users(config)
         heartbeat = config.duration_s("chana.mq.amqp.connection.heartbeat")
         sweep = config.duration_s("chana.mq.message.sweep-interval")
         low = config.size_bytes("chana.mq.memory.low-watermark")
@@ -205,7 +211,8 @@ class BrokerServer:
             backlog=config.int("chana.mq.server.backlog") or 128,
             max_message_size=config.size_bytes("chana.mq.message.max-size")
             or 0,
-            users=cls._config_users(config),
+            users=users,
+            permissions=cls._config_permissions(config, users),
         )
 
     @staticmethod
@@ -224,6 +231,33 @@ class BrokerServer:
             raise ConfigError(
                 "chana.mq.auth.users must map user names to passwords")
         return users
+
+    @staticmethod
+    def _config_permissions(config, users: Optional[dict]) -> Optional[dict]:
+        """chana.mq.auth.permissions, validated fail-closed like users:
+        allowlists without a user table (or naming unknown users) would be
+        silently unenforced, so both are boot errors."""
+        perms = config.get("chana.mq.auth.permissions")
+        if perms is None or perms == {}:
+            return None
+        from ..config import ConfigError
+
+        ok = isinstance(perms, dict) and all(
+            isinstance(k, str) and isinstance(v, list)
+            and all(isinstance(x, str) for x in v)
+            for k, v in perms.items()
+        )
+        if not ok:
+            raise ConfigError(
+                "chana.mq.auth.permissions must map user names to vhost lists")
+        if users is None:
+            raise ConfigError(
+                "chana.mq.auth.permissions requires chana.mq.auth.users")
+        unknown = sorted(set(perms) - set(users))
+        if unknown:
+            raise ConfigError(
+                f"chana.mq.auth.permissions names unknown users: {unknown}")
+        return perms
 
 
 async def run_node(config) -> None:

@@ -52,6 +52,10 @@ DEFAULTS: dict[str, Any] = {
     # disables verification (the reference parses but never verifies,
     # SaslMechanism.scala:49-76); configuring users also refuses EXTERNAL.
     "chana.mq.auth.users": None,
+    # optional per-user vhost allowlists: {"user": ["/", "tenant-a"], ...}.
+    # Only consulted when users are configured; a user absent from the map
+    # may open ANY vhost (allowlist opt-in per user).
+    "chana.mq.auth.permissions": None,
     "chana.mq.internal.timeout": "20s",
     "chana.mq.message.inactive": "1h",
     "chana.mq.message.sweep-interval": "1s",
@@ -135,7 +139,8 @@ def _env_key(path: str) -> str:
 
 # keys whose VALUE is a mapping: flattening stops here so a config file's
 # {"auth": {"users": {...}}} arrives as one dict, not per-user leaf keys
-_DICT_LEAF_KEYS = frozenset({"chana.mq.auth.users"})
+_DICT_LEAF_KEYS = frozenset(
+    {"chana.mq.auth.users", "chana.mq.auth.permissions"})
 
 
 def _flatten(tree: Mapping[str, Any], prefix: str = "") -> dict[str, Any]:

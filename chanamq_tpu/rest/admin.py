@@ -6,8 +6,9 @@ Capability parity with the reference's AdminApi
 with the observability endpoints the reference lacked (SURVEY.md §5):
 metrics snapshot, overview, and per-queue stats.
 
-Hand-rolled HTTP/1.1 on asyncio (no third-party web framework in the image);
-GET-only, JSON responses.
+Hand-rolled HTTP/1.1 on asyncio (no third-party web framework in the image).
+Reads are GET with JSON responses (plus the text-format Prometheus scrape at
+/metrics); vhost mutations require POST.
 """
 
 from __future__ import annotations
@@ -62,10 +63,16 @@ class AdminServer:
                 if line in (b"\r\n", b"\n", b""):
                     break
             status, payload = await self._route(method, path)
-            body = json.dumps(payload, default=str).encode()
+            if isinstance(payload, str):
+                # pre-rendered text body (Prometheus exposition format)
+                body = payload.encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
+                body = json.dumps(payload, default=str).encode()
+                ctype = "application/json"
             writer.write(
                 f"HTTP/1.1 {status}\r\n"
-                f"Content-Type: application/json\r\n"
+                f"Content-Type: {ctype}\r\n"
                 f"Content-Length: {len(body)}\r\n"
                 f"Connection: close\r\n\r\n".encode() + body
             )
@@ -86,6 +93,13 @@ class AdminServer:
         if method not in ("GET", "POST"):
             return "405 Method Not Allowed", {"error": "GET/POST only"}
         segments = [unquote(s) for s in path.strip("/").split("/") if s]
+        if segments == ["metrics"] and method == "GET":
+            # conventional Prometheus scrape path (text exposition format);
+            # errors still produce an HTTP response, not a dropped scrape
+            try:
+                return "200 OK", self._prometheus()
+            except Exception as exc:
+                return "500 Internal Server Error", {"error": str(exc)}
         if not segments or segments[0] != "admin":
             return "404 Not Found", {"error": "unknown path"}
         segments = segments[1:]
@@ -119,6 +133,53 @@ class AdminServer:
         except Exception as exc:
             return "500 Internal Server Error", {"error": str(exc)}
         return "404 Not Found", {"error": "unknown path"}
+
+    # metric name -> prometheus type; everything else in the snapshot is a
+    # gauge. Latency percentiles are exported as computed gauges (the
+    # histogram buckets aren't cumulative-format compatible as stored).
+    _PROM_COUNTERS = frozenset({
+        "published_msgs", "published_bytes", "delivered_msgs",
+        "delivered_bytes", "returned_msgs", "confirmed_msgs",
+        "expired_msgs", "dead_lettered_msgs", "connections_opened",
+        "connections_closed", "connections_refused",
+    })
+
+    @staticmethod
+    def _prom_label(value: str) -> str:
+        return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+    def _prometheus(self) -> str:
+        """Prometheus text exposition of the broker metrics + per-queue
+        gauges (exceeds the reference, which had no metrics at all —
+        SURVEY.md §5 'observability': throughput was measured by grepping
+        log lines)."""
+        out: list[str] = []
+        snap = self.broker.metrics_snapshot()
+        for key, value in snap.items():
+            if isinstance(value, bool):
+                value = int(value)  # e.g. memory_blocked -> 0/1 gauge
+            if not isinstance(value, (int, float)):
+                continue  # None percentiles before any traffic
+            kind = "counter" if key in self._PROM_COUNTERS else "gauge"
+            out.append(f"# TYPE chanamq_{key} {kind}")
+            out.append(f"chanamq_{key} {value}")
+        out.append("# TYPE chanamq_queue_messages gauge")
+        out.append("# TYPE chanamq_queue_ready_bytes gauge")
+        out.append("# TYPE chanamq_queue_unacked gauge")
+        out.append("# TYPE chanamq_queue_consumers gauge")
+        for vhost in self.broker.vhosts.values():
+            vl = self._prom_label(vhost.name)
+            for queue in vhost.queues.values():
+                labels = f'{{vhost="{vl}",queue="{self._prom_label(queue.name)}"}}'
+                out.append(
+                    f"chanamq_queue_messages{labels} {queue.message_count}")
+                out.append(
+                    f"chanamq_queue_ready_bytes{labels} {queue.ready_bytes}")
+                out.append(
+                    f"chanamq_queue_unacked{labels} {len(queue.outstanding)}")
+                out.append(
+                    f"chanamq_queue_consumers{labels} {queue.consumer_count}")
+        return "\n".join(out) + "\n"
 
     def _overview(self) -> dict:
         return {

@@ -448,3 +448,98 @@ async def test_auth_users_from_config_file_and_env(tmp_path):
     with pytest.raises(ConfigError):
         BrokerServer.from_config(
             Config(overrides={"chana.mq.auth.users": "alice:pw"}, env={}))
+
+
+async def http_text(port: int, path: str) -> tuple[int, str, str]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: localhost\r\n\r\n".encode())
+    await writer.drain()
+    # the server sends Connection: close — read to EOF so a response split
+    # across TCP segments can't truncate the body
+    raw = await asyncio.wait_for(reader.read(), 5)
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = int(head.split()[1])
+    ctype = ""
+    for line in head.decode("latin-1").split("\r\n"):
+        if line.lower().startswith("content-type:"):
+            ctype = line.split(":", 1)[1].strip()
+    return status, ctype, body.decode()
+
+
+async def test_prometheus_metrics_endpoint(stack):
+    """GET /metrics serves the Prometheus text exposition format: typed
+    broker counters/gauges plus per-queue gauges with vhost/queue labels
+    (the reference had no metrics subsystem at all)."""
+    server, admin = stack
+    c = await AMQPClient.connect("127.0.0.1", server.bound_port)
+    ch = await c.channel()
+    await ch.queue_declare("prom_q")
+    ch.basic_publish(b"x" * 64, routing_key="prom_q")
+    await asyncio.sleep(0.05)
+
+    status, ctype, text = await http_text(admin.bound_port, "/metrics")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    lines = text.splitlines()
+    assert "# TYPE chanamq_published_msgs counter" in lines
+    assert "# TYPE chanamq_resident_bytes gauge" in lines
+    metrics = {}
+    for line in lines:
+        if line.startswith("#") or not line:
+            continue
+        name, _, value = line.rpartition(" ")
+        metrics[name] = float(value)
+    assert metrics["chanamq_published_msgs"] >= 1
+    assert metrics['chanamq_queue_messages{vhost="/",queue="prom_q"}'] == 1
+    assert metrics['chanamq_queue_ready_bytes{vhost="/",queue="prom_q"}'] == 64
+    assert metrics["chanamq_memory_blocked"] == 0
+    await c.close()
+
+
+async def test_vhost_permissions_enforced():
+    """chana.mq.auth.permissions: a user with an allowlist may open only
+    those vhosts; users absent from the map stay unrestricted."""
+    from chanamq_tpu.broker.server import BrokerServer
+    from chanamq_tpu.client import AMQPClient
+    from chanamq_tpu.client.client import ConnectionClosedError
+
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                       users={"tenant": "pw", "admin": "pw"},
+                       permissions={"tenant": ["tenant-vh"]})
+    await srv.start()
+    await srv.broker.create_vhost("tenant-vh")
+    try:
+        # tenant: allowed vhost works
+        c = await AMQPClient.connect("127.0.0.1", srv.bound_port,
+                                     vhost="tenant-vh",
+                                     username="tenant", password="pw")
+        await c.close()
+        # tenant: default vhost refused
+        with pytest.raises((ConnectionClosedError, OSError,
+                            asyncio.IncompleteReadError,
+                            asyncio.TimeoutError)):
+            await AMQPClient.connect("127.0.0.1", srv.bound_port,
+                                     vhost="/",
+                                     username="tenant", password="pw")
+        # admin (no allowlist entry): unrestricted
+        c = await AMQPClient.connect("127.0.0.1", srv.bound_port, vhost="/",
+                                     username="admin", password="pw")
+        await c.close()
+    finally:
+        await srv.stop()
+
+
+async def test_permissions_config_fails_closed():
+    """Allowlists that could silently not be enforced are boot errors:
+    permissions without users, or permissions naming unknown users."""
+    from chanamq_tpu.broker.server import BrokerServer
+    from chanamq_tpu.config import Config, ConfigError
+
+    with pytest.raises(ConfigError):
+        BrokerServer.from_config(Config(
+            overrides={"chana.mq.auth.permissions": {"t": ["/"]}}, env={}))
+    with pytest.raises(ConfigError):
+        BrokerServer.from_config(Config(overrides={
+            "chana.mq.auth.users": {"alice": "pw"},
+            "chana.mq.auth.permissions": {"bob": ["/"]}}, env={}))
