@@ -38,7 +38,7 @@ class Consumer:
 
     __slots__ = (
         "tag", "channel", "queue", "no_ack", "exclusive", "arguments",
-        "unacked_count", "unacked_size", "_deliver_prefix",
+        "priority", "unacked_count", "unacked_size", "_deliver_prefix",
     )
 
     def __init__(
@@ -56,6 +56,10 @@ class Consumer:
         self.no_ack = no_ack
         self.exclusive = exclusive
         self.arguments = arguments or {}
+        # consumer priority (RabbitMQ x-priority consume argument, default
+        # 0; higher is served first while it has prefetch budget — an
+        # extension the reference lacks)
+        self.priority = int(self.arguments.get("x-priority") or 0)
         self.unacked_count = 0
         self.unacked_size = 0
         # precomputed basic.deliver method-payload prefix:

@@ -1312,6 +1312,12 @@ class AMQPConnection:
             raise ChannelError(
                 ErrorCode.NOT_ALLOWED, f"consumer tag '{tag}' in use",
                 method.CLASS_ID, method.METHOD_ID)
+        # validated up front so local and remotely-owned queues agree
+        x_priority = (method.arguments or {}).get("x-priority")
+        if x_priority is not None and not isinstance(x_priority, int):
+            raise ChannelError(
+                ErrorCode.PRECONDITION_FAILED, "invalid x-priority",
+                method.CLASS_ID, method.METHOD_ID)
         site, queue = self.broker.queue_site(self.vhost_name, method.queue, self.id)
         if site == "activate":
             queue = await self.broker.activate_queue(self.vhost_name, method.queue)
@@ -1328,7 +1334,7 @@ class AMQPConnection:
             credit = min(credit, DEFAULT_CREDIT) if credit else DEFAULT_CREDIT
             await self.broker.cluster.remote_consume(
                 channel, self.vhost_name, method.queue, tag,
-                method.no_ack, credit)
+                method.no_ack, credit, priority=int(x_priority or 0))
             if not method.nowait:
                 self.send_method(channel.id, am.Basic.ConsumeOk(consumer_tag=tag))
             return

@@ -193,6 +193,9 @@ class Queue:
         self.last_consumed = 0
         self.consumers: list["Consumer"] = []
         self._rr_index = 0
+        # priority dispatch groups ([consumers, rotation-index] per level,
+        # highest first); None = all default priority (flat RR fast path)
+        self._prio_groups: Optional[list[list]] = None
         self.outstanding: dict[int, Delivery] = {}  # msg offset -> delivery
         self.had_consumer = False  # auto-delete arms only after first consumer
         self.deleted = False
@@ -545,7 +548,12 @@ class Queue:
 
     def _next_eligible_consumer(self, size: int) -> Optional["Consumer"]:
         """Round-robin pick of a consumer with prefetch budget for a
-        `size`-byte delivery (reference fair poll: AMQChannel.scala:43-48)."""
+        `size`-byte delivery (reference fair poll: AMQChannel.scala:43-48).
+        With x-priority consumers present (RabbitMQ extension), higher
+        priorities are served first while they have budget, round-robin
+        within a level; the flat fast path is untouched otherwise."""
+        if self._prio_groups is not None:
+            return self._next_by_priority(size)
         n = len(self.consumers)
         for i in range(n):
             consumer = self.consumers[(self._rr_index + i) % n]
@@ -553,6 +561,36 @@ class Queue:
                 self._rr_index = (self._rr_index + i + 1) % n
                 return consumer
         return None
+
+    def _next_by_priority(self, size: int) -> Optional["Consumer"]:
+        """Walk priority levels high to low; round-robin WITHIN a level via
+        its own rotation index (a shared index would let the top level
+        reset rotation and starve lower-level siblings). The groups are
+        rebuilt only on consumer add/remove, not per delivery."""
+        for group in self._prio_groups:
+            consumers, start = group[0], group[1]
+            n = len(consumers)
+            for i in range(n):
+                consumer = consumers[(start + i) % n]
+                if consumer.can_take(size):
+                    group[1] = (start + i + 1) % n
+                    return consumer
+        return None
+
+    def _rebuild_prio_groups(self) -> None:
+        """Consumer set changed: rebuild the priority-ordered dispatch
+        groups, or drop back to the flat fast path when every consumer is
+        at default priority."""
+        if not any(getattr(c, "priority", 0) for c in self.consumers):
+            self._prio_groups = None
+            return
+        levels: dict[int, list] = {}
+        for consumer in self.consumers:
+            levels.setdefault(getattr(consumer, "priority", 0), []).append(
+                consumer)
+        self._prio_groups = [
+            [levels[priority], 0] for priority in sorted(levels, reverse=True)
+        ]
 
     # -- get (polling read) ------------------------------------------------
 
@@ -693,6 +731,8 @@ class Queue:
 
     def add_consumer(self, consumer: "Consumer") -> None:
         self.consumers.append(consumer)
+        if self._prio_groups is not None or getattr(consumer, "priority", 0):
+            self._rebuild_prio_groups()
         self.had_consumer = True
         self.last_used = now_ms()
         self.schedule_dispatch()
@@ -704,6 +744,8 @@ class Queue:
             self.consumers.remove(consumer)
         except ValueError:
             return False
+        if self._prio_groups is not None:
+            self._rebuild_prio_groups()
         self.last_used = now_ms()
         if self.auto_delete and self.had_consumer and not self.consumers:
             return True

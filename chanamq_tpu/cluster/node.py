@@ -326,6 +326,7 @@ class ClusterNode:
                     "vhost": vhost, "queue": queue, "tag": tag,
                     "no_ack": info["no_ack"], "origin": self.name,
                     "credit": info["credit"],
+                    "priority": info.get("priority", 0),
                 })
                 info["owner"] = owner
                 info["alive"] = True
@@ -717,7 +718,8 @@ class ClusterNode:
                 queue.consumers.remove(consumer)
         consumer = RemoteConsumer(
             self, tag, queue, bool(payload.get("no_ack")), origin,
-            int(payload.get("credit", DEFAULT_CREDIT)))
+            int(payload.get("credit", DEFAULT_CREDIT)),
+            priority=int(payload.get("priority", 0)))
         queue.add_consumer(consumer)
         return {"ok": True}
 
@@ -894,7 +896,7 @@ class ClusterNode:
 
     async def remote_consume(
         self, channel: "ServerChannel", vhost: str, name: str, tag: str,
-        no_ack: bool, credit: int = DEFAULT_CREDIT,
+        no_ack: bool, credit: int = DEFAULT_CREDIT, priority: int = 0,
     ) -> "RemoteQueueRef":
         owner = self.queue_owner(vhost, name)
         ref = RemoteQueueRef(self, vhost, name)
@@ -903,12 +905,14 @@ class ClusterNode:
         stub = Consumer(tag, channel, ref, no_ack, False)  # type: ignore[arg-type]
         self._remote_consumers[(vhost, name, tag)] = {
             "channel": channel, "stub": stub, "no_ack": no_ack,
+            "priority": priority,
             "credit": credit, "owner": owner, "pending_credit": 0,
         }
         try:
             await self._call(owner, "queue.consume", {
                 "vhost": vhost, "queue": name, "tag": tag,
-                "no_ack": no_ack, "origin": self.name, "credit": credit})
+                "no_ack": no_ack, "origin": self.name, "credit": credit,
+                "priority": priority})
         except Exception:
             self._remote_consumers.pop((vhost, name, tag), None)
             raise
@@ -1002,14 +1006,19 @@ class RemoteConsumer:
     Implements the Consumer dispatch interface (can_take / deliver / detach)."""
 
     __slots__ = ("cluster", "tag", "queue", "no_ack", "origin", "credit",
-                 "exclusive", "outstanding_offsets", "_buf", "_flush_scheduled")
+                 "exclusive", "priority", "outstanding_offsets", "_buf",
+                 "_flush_scheduled")
 
     def __init__(self, cluster: ClusterNode, tag: str, queue: "Queue",
-                 no_ack: bool, origin: str, credit: int) -> None:
+                 no_ack: bool, origin: str, credit: int,
+                 priority: int = 0) -> None:
         self.cluster = cluster
         self.tag = tag
         self.queue = queue
         self.no_ack = no_ack
+        # x-priority forwarded from the origin's basic.consume: the owner's
+        # dispatch honors it like a local consumer's
+        self.priority = priority
         self.origin = origin
         self.credit = credit
         self.exclusive = False

@@ -868,3 +868,41 @@ async def test_tx_commit_over_remotely_owned_queue(tmp_path):
     finally:
         for node in nodes:
             await node.stop()
+
+
+async def test_remote_consumer_priority_honored_by_owner(tmp_path):
+    """x-priority forwarded over the consume RPC: the owner's dispatch
+    prefers the remote high-priority consumer over a local default one."""
+    nodes = await start_cluster(tmp_path, 2)
+    try:
+        name = None
+        for i in range(100):
+            cand = f"prio_rc_q{i}"
+            if nodes[0].cluster.queue_owner("/", cand) == nodes[1].name:
+                name = cand
+                break
+        assert name is not None
+        # origin-side high-priority consumer (remote to the owner)
+        c0 = await AMQPClient.connect("127.0.0.1", nodes[0].port)
+        ch0 = await c0.channel()
+        await ch0.queue_declare(name, durable=True)
+        hi_got, lo_got = [], []
+        await ch0.basic_consume(name, hi_got.append, no_ack=True,
+                                arguments={"x-priority": 7})
+        # owner-local default-priority consumer
+        c1 = await AMQPClient.connect("127.0.0.1", nodes[1].port)
+        ch1 = await c1.channel()
+        await ch1.basic_consume(name, lo_got.append, no_ack=True)
+        await asyncio.sleep(0.2)
+        for i in range(8):
+            ch1.basic_publish(b"p%d" % i, routing_key=name,
+                              properties=PERSISTENT)
+        await asyncio.sleep(0.5)
+        # the remote high-priority consumer (credit window >> 8) gets all
+        assert len(hi_got) == 8, (len(hi_got), len(lo_got))
+        assert lo_got == []
+        await c0.close()
+        await c1.close()
+    finally:
+        for node in nodes:
+            await node.stop()

@@ -333,3 +333,89 @@ async def test_queue_extension_arguments_survive_restart(tmp_path):
         await c2.close()
     finally:
         await srv2.stop()
+
+
+# -- consumer priorities (x-priority consume argument) ----------------------
+
+
+async def test_consumer_priority_preferred_while_it_has_budget(server):
+    """x-priority consumers are served first while they have prefetch
+    budget; deliveries spill to lower priorities when the window is full
+    (RabbitMQ consumer-priority semantics; the reference round-robins
+    only)."""
+    from chanamq_tpu.client import AMQPClient as _C
+
+    c_hi = await _C.connect("127.0.0.1", server.bound_port)
+    c_lo = await _C.connect("127.0.0.1", server.bound_port)
+    try:
+        setup = await c_hi.channel()
+        await setup.queue_declare("prio_q")
+
+        hi_got, lo_got = [], []
+        ch_hi = await c_hi.channel()
+        await ch_hi.basic_qos(prefetch_count=2)
+        await ch_hi.basic_consume("prio_q", hi_got.append,
+                                  arguments={"x-priority": 10})
+        ch_lo = await c_lo.channel()
+        await ch_lo.basic_qos(prefetch_count=100)
+        await ch_lo.basic_consume("prio_q", lo_got.append)
+
+        for i in range(6):
+            setup.basic_publish(b"p%d" % i, routing_key="prio_q")
+        await asyncio.sleep(0.3)
+        # high priority takes its full window of 2; the rest spill to low
+        assert len(hi_got) == 2, (hi_got, lo_got)
+        assert len(lo_got) == 4
+        assert [m.body for m in hi_got] == [b"p0", b"p1"]
+        # acking frees the window: the next message prefers high again
+        for m in hi_got:
+            ch_hi.basic_ack(m.delivery_tag)
+        setup.basic_publish(b"p6", routing_key="prio_q")
+        await asyncio.sleep(0.2)
+        assert [m.body for m in hi_got[2:]] == [b"p6"]
+    finally:
+        await c_hi.close()
+        await c_lo.close()
+
+
+async def test_consumer_priority_invalid_argument_rejected(client):
+    ch = await client.channel()
+    await ch.queue_declare("prio_bad_q")
+    with pytest.raises(ChannelClosedError) as exc_info:
+        await ch.basic_consume("prio_bad_q", lambda m: None,
+                               arguments={"x-priority": "high"})
+    assert exc_info.value.reply_code == 406
+
+
+async def test_consumer_priority_round_robin_within_level(server):
+    """Spills below a busy high-priority consumer still round-robin across
+    ALL lower-level siblings (per-level rotation indexes)."""
+    from chanamq_tpu.client import AMQPClient as _C
+
+    c_hi = await _C.connect("127.0.0.1", server.bound_port)
+    c_lo = await _C.connect("127.0.0.1", server.bound_port)
+    try:
+        setup = await c_hi.channel()
+        await setup.queue_declare("prio_rr_q")
+        ch_hi = await c_hi.channel()
+        await ch_hi.basic_qos(prefetch_count=1)
+        hi_got = []
+        await ch_hi.basic_consume("prio_rr_q", hi_got.append,
+                                  arguments={"x-priority": 10})
+        counts = {"a": 0, "b": 0, "c": 0}
+        ch_lo = await c_lo.channel()
+        for name in counts:
+            def mk(n):
+                return lambda m: counts.__setitem__(n, counts[n] + 1)
+            await ch_lo.basic_consume("prio_rr_q", mk(name), no_ack=True,
+                                      consumer_tag=f"lo-{name}")
+        for i in range(10):
+            setup.basic_publish(b"m%d" % i, routing_key="prio_rr_q")
+        await asyncio.sleep(0.3)
+        # high takes 1 (window full, never acked); 9 spill across a/b/c
+        assert len(hi_got) == 1
+        assert sum(counts.values()) == 9
+        assert all(v >= 2 for v in counts.values()), counts
+    finally:
+        await c_hi.close()
+        await c_lo.close()
