@@ -430,8 +430,16 @@ class Broker:
             raise BrokerError(
                 ErrorCode.COMMAND_INVALID, f"unknown exchange type '{type}'"
             ) from None
+        alt = (arguments or {}).get("alternate-exchange")
+        if alt is not None and not isinstance(alt, str):
+            raise BrokerError(
+                ErrorCode.PRECONDITION_FAILED, "invalid alternate-exchange")
         if existing is not None:
-            if not existing.equivalent(ex_type, durable, auto_delete, internal):
+            if (not existing.equivalent(ex_type, durable, auto_delete, internal)
+                    or existing.alternate != alt):
+                # alternate-exchange is behavior-bearing: silently ignoring
+                # a differing redeclare would let a client believe its AE
+                # is active (RabbitMQ: 406 inequivalent arg)
                 raise BrokerError(
                     ErrorCode.PRECONDITION_FAILED,
                     f"exchange '{name}' redeclared with different settings")
@@ -452,7 +460,8 @@ class Broker:
             self.cluster.broadcast_bg("meta.apply", {
                 "kind": "exchange.declared", "vhost": vhost_name, "name": name,
                 "type": ex_type, "durable": durable,
-                "auto_delete": auto_delete, "internal": internal, "binds": [],
+                "auto_delete": auto_delete, "internal": internal,
+                "arguments": arguments or {}, "binds": [],
             })
         return exchange
 
@@ -974,6 +983,7 @@ class Broker:
             if exchange_name == "" or (
                 exchange is not None
                 and exchange.ex_matcher is None
+                and exchange.alternate is None
                 and exchange.type != "headers"
             ):
                 if len(cache) >= self._ROUTE_CACHE_MAX:

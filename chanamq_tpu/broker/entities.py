@@ -780,6 +780,11 @@ class Exchange:
         # names. None until the first e2e bind, so the common single-hop
         # publish path pays nothing for the feature.
         self.ex_matcher: Optional[Matcher] = None
+        # alternate exchange (RabbitMQ extension): messages this exchange
+        # cannot route (no binding matched) fall through to the named
+        # exchange instead of being dropped/returned
+        alt = self.arguments.get("alternate-exchange")
+        self.alternate: Optional[str] = alt if isinstance(alt, str) else None
 
     def ensure_ex_matcher(self) -> Matcher:
         if self.ex_matcher is None:
@@ -844,11 +849,14 @@ class VHost:
         if exchange_name == "":
             # default exchange: implicit binding queue-name == routing-key
             return {routing_key} if routing_key in self.queues else set()
-        if exchange.ex_matcher is None:
+        if exchange.ex_matcher is None and exchange.alternate is None:
             return exchange.route(routing_key, headers)
-        queues = set(exchange.route(routing_key, headers))
-        visited = {exchange_name}
-        frontier = exchange.ex_matcher.route(routing_key, headers)
+        # graph walk covering e2e bindings AND alternate-exchange fallback:
+        # an exchange that routes the key nowhere (no queue, no e2e target)
+        # hands it to its alternate; cycle-safe via the visited set
+        queues: set[str] = set()
+        visited: set[str] = set()
+        frontier = {exchange_name}
         while frontier:
             hop: set[str] = set()
             for ex_name in frontier:
@@ -858,9 +866,19 @@ class VHost:
                 ex = self.exchanges.get(ex_name)
                 if ex is None:
                     continue  # dangling bind to a deleted exchange
-                queues |= ex.route(routing_key, headers)
-                if ex.ex_matcher is not None:
-                    hop |= ex.ex_matcher.route(routing_key, headers)
+                if ex.name == "":
+                    # default exchange as an alternate target: implicit
+                    # queue-name binding
+                    if routing_key in self.queues:
+                        queues.add(routing_key)
+                    continue
+                matched = ex.route(routing_key, headers)
+                targets = (ex.ex_matcher.route(routing_key, headers)
+                           if ex.ex_matcher is not None else set())
+                if not matched and not targets and ex.alternate is not None:
+                    hop.add(ex.alternate)
+                queues |= matched
+                hop |= targets
             frontier = hop
         return queues
 

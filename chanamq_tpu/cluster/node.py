@@ -415,6 +415,7 @@ class ClusterNode:
                     "type": exchange.type, "durable": exchange.durable,
                     "auto_delete": exchange.auto_delete,
                     "internal": exchange.internal,
+                    "arguments": dict(exchange.arguments or {}),
                     "binds": [
                         {"key": key, "queue": queue, "args": args or {}}
                         for key, queue, args in exchange.matcher.bindings()
@@ -478,6 +479,7 @@ class ClusterNode:
                     durable=bool(payload.get("durable")),
                     auto_delete=bool(payload.get("auto_delete")),
                     internal=bool(payload.get("internal")),
+                    arguments=dict(payload.get("arguments") or {}),
                 )
             exchange = vhost.exchanges[name]
             for bind in payload.get("binds") or []:

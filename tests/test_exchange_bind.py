@@ -254,3 +254,106 @@ async def test_durable_e2e_binding_survives_restart(tmp_path):
         await c2.close()
     finally:
         await srv2.stop()
+
+
+# -- alternate exchanges ----------------------------------------------------
+
+
+async def test_alternate_exchange_catches_unroutable(client):
+    ch = await client.channel()
+    await ch.exchange_declare("ae_unrouted", "fanout")
+    await ch.queue_declare("q_unrouted")
+    await ch.queue_bind("q_unrouted", "ae_unrouted", "")
+    await ch.exchange_declare("ae_main", "direct", arguments={
+        "alternate-exchange": "ae_unrouted"})
+    await ch.queue_declare("q_known")
+    await ch.queue_bind("q_known", "ae_main", "known")
+
+    ch.basic_publish(b"hit", exchange="ae_main", routing_key="known")
+    ch.basic_publish(b"miss", exchange="ae_main", routing_key="other")
+    assert [m.body for m in await drain(ch, "q_known", 1)] == [b"hit"]
+    assert [m.body for m in await drain(ch, "q_unrouted", 1)] == [b"miss"]
+    # the matched message did NOT also go to the alternate
+    await asyncio.sleep(0.05)
+    assert await ch.basic_get("q_unrouted", no_ack=True) is None
+
+
+async def test_alternate_exchange_cycle_safe(client):
+    ch = await client.channel()
+    await ch.exchange_declare("ae_a", "direct",
+                              arguments={"alternate-exchange": "ae_b"})
+    await ch.exchange_declare("ae_b", "direct",
+                              arguments={"alternate-exchange": "ae_a"})
+    ch.basic_publish(b"nowhere", exchange="ae_a", routing_key="k")
+    await asyncio.sleep(0.05)  # no hang, no crash
+    ch2 = await client.channel()
+    await ch2.queue_declare("ae_alive")
+    ch2.basic_publish(b"ok", routing_key="ae_alive")
+    assert (await drain(ch2, "ae_alive", 1))[0].body == b"ok"
+
+
+async def test_alternate_exchange_suppresses_mandatory_return(client):
+    """A message the alternate exchange routes counts as routed: no
+    Basic.Return even with mandatory set (RabbitMQ semantics)."""
+    ch = await client.channel()
+    await ch.exchange_declare("ae_sink", "fanout")
+    await ch.queue_declare("q_sink")
+    await ch.queue_bind("q_sink", "ae_sink", "")
+    await ch.exchange_declare("ae_mand", "direct", arguments={
+        "alternate-exchange": "ae_sink"})
+    ch.basic_publish(b"saved", exchange="ae_mand", routing_key="nope",
+                     mandatory=True)
+    assert [m.body for m in await drain(ch, "q_sink", 1)] == [b"saved"]
+    await asyncio.sleep(0.05)
+    assert ch.returns == []
+    # but with no AE target bound, mandatory still returns
+    await ch.queue_unbind("q_sink", "ae_sink", "")
+    ch.basic_publish(b"lost", exchange="ae_mand", routing_key="nope",
+                     mandatory=True)
+    await asyncio.sleep(0.1)
+    assert len(ch.returns) == 1 and ch.returns[0].reply_code == 312
+
+
+async def test_alternate_exchange_survives_restart(tmp_path):
+    db_path = str(tmp_path / "ae.db")
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                       store=SqliteStore(db_path))
+    await srv.start()
+    try:
+        c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        ch = await c.channel()
+        await ch.exchange_declare("ae_p_sink", "fanout", durable=True)
+        await ch.queue_declare("q_p_sink", durable=True)
+        await ch.queue_bind("q_p_sink", "ae_p_sink", "")
+        await ch.exchange_declare("ae_p", "direct", durable=True, arguments={
+            "alternate-exchange": "ae_p_sink"})
+        await c.close()
+    finally:
+        await srv.stop()
+    srv2 = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                        store=SqliteStore(db_path))
+    await srv2.start()
+    try:
+        c2 = await AMQPClient.connect("127.0.0.1", srv2.bound_port)
+        ch2 = await c2.channel()
+        ch2.basic_publish(b"after-restart", exchange="ae_p",
+                          routing_key="unbound")
+        got = await drain(ch2, "q_p_sink", 1)
+        assert [m.body for m in got] == [b"after-restart"]
+        await c2.close()
+    finally:
+        await srv2.stop()
+
+
+async def test_alternate_exchange_inequivalent_redeclare_rejected(client):
+    """Redeclaring with a different (or newly added) alternate-exchange is
+    a 406, never a silent no-op the client mistakes for an active AE."""
+    ch = await client.channel()
+    await ch.exchange_declare("ae_eq", "direct")
+    with pytest.raises(ChannelClosedError) as exc_info:
+        await ch.exchange_declare("ae_eq", "direct", arguments={
+            "alternate-exchange": "somewhere"})
+    assert exc_info.value.reply_code == 406
+    # same settings redeclare still fine
+    ch2 = await client.channel()
+    await ch2.exchange_declare("ae_eq", "direct")
