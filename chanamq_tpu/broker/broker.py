@@ -1016,7 +1016,12 @@ class Broker:
                 and (vhost_name, routing_key) in self.cluster.queue_metas)
             queue_names = {routing_key} if exists else set()
         else:
-            queue_names = vhost.route(exchange_name, routing_key, properties.headers)
+            cluster = self.cluster
+            queue_names = vhost.route(
+                exchange_name, routing_key, properties.headers,
+                queue_exists=(
+                    (lambda rk: (vhost_name, rk) in cluster.queue_metas)
+                    if cluster is not None else None))
             assert queue_names is not None
         return vhost, queue_names
 

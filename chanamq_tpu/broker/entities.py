@@ -833,7 +833,9 @@ class VHost:
             )
 
     def route(
-        self, exchange_name: str, routing_key: str, headers: Optional[dict] = None
+        self, exchange_name: str, routing_key: str,
+        headers: Optional[dict] = None,
+        queue_exists: Optional[Any] = None,
     ) -> Optional[set[str]]:
         """Resolve target queue names; None when the exchange doesn't exist.
 
@@ -868,8 +870,12 @@ class VHost:
                     continue  # dangling bind to a deleted exchange
                 if ex.name == "":
                     # default exchange as an alternate target: implicit
-                    # queue-name binding
-                    if routing_key in self.queues:
+                    # queue-name binding. queue_exists (broker-supplied in
+                    # cluster mode) also covers remotely-owned queues that
+                    # exist here only as replicated metadata.
+                    if routing_key in self.queues or (
+                            queue_exists is not None
+                            and queue_exists(routing_key)):
                         queues.add(routing_key)
                     continue
                 matched = ex.route(routing_key, headers)

@@ -906,3 +906,40 @@ async def test_remote_consumer_priority_honored_by_owner(tmp_path):
     finally:
         for node in nodes:
             await node.stop()
+
+
+async def test_alternate_exchange_to_default_reaches_remote_queue(tmp_path):
+    """AE "" fallback must see clustered queues that exist on the publishing
+    node only as replicated metadata (the default-exchange implicit binding
+    consults cluster.queue_metas, not just local queues)."""
+    nodes = await start_cluster(tmp_path, 2)
+    try:
+        name = None
+        for i in range(100):
+            cand = f"ae_remote_q{i}"
+            if nodes[0].cluster.queue_owner("/", cand) == nodes[1].name:
+                name = cand
+                break
+        assert name is not None
+        c0 = await AMQPClient.connect("127.0.0.1", nodes[0].port)
+        ch0 = await c0.channel()
+        await ch0.queue_declare(name, durable=True)
+        await ch0.exchange_declare("ae_cluster_ex", "direct", arguments={
+            "alternate-exchange": ""})
+        await asyncio.sleep(0.2)
+        # unroutable on the exchange; the AE "" must route by queue name to
+        # the node-1-owned queue
+        ch0.basic_publish(b"fell-to-remote", exchange="ae_cluster_ex",
+                          routing_key=name, properties=PERSISTENT)
+        await asyncio.sleep(0.4)
+        c1 = await AMQPClient.connect("127.0.0.1", nodes[1].port)
+        ch1 = await c1.channel()
+        ok = await ch1.queue_declare(name, passive=True)
+        assert ok.message_count == 1
+        got = await ch1.basic_get(name, no_ack=True)
+        assert got is not None and got.body == b"fell-to-remote"
+        await c0.close()
+        await c1.close()
+    finally:
+        for node in nodes:
+            await node.stop()

@@ -365,3 +365,47 @@ async def test_expired_passivated_entries_leave_the_deque():
         assert broker.resident_bytes == 0
     finally:
         await broker.stop()
+
+
+async def test_passivated_messages_dead_letter_with_hydrated_bodies(tmp_path):
+    """A passivated (body paged out) message that expires in a DLX'd queue
+    is hydrated from the store before forwarding: the dead-letter queue
+    receives the FULL body, not an empty shell."""
+    from chanamq_tpu.broker.broker import Broker
+    from chanamq_tpu.broker.server import BrokerServer
+    from chanamq_tpu.client import AMQPClient
+    from chanamq_tpu.store.sqlite import SqliteStore
+
+    broker = Broker(store=SqliteStore(str(tmp_path / "pdlx.db")),
+                    queue_max_resident=4, message_sweep_interval_s=0.1)
+    srv = BrokerServer(broker=broker, host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    try:
+        c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        ch = await c.channel()
+        await ch.exchange_declare("pdlx_ex", "fanout")
+        await ch.queue_declare("pdlx_dlq")
+        await ch.queue_bind("pdlx_dlq", "pdlx_ex", "")
+        await ch.queue_declare("pdlx_q", arguments={
+            "x-message-ttl": 300, "x-dead-letter-exchange": "pdlx_ex"})
+        bodies = [b"deep-%03d" % i + b"x" * 100 for i in range(16)]
+        for body in bodies:
+            ch.basic_publish(body, routing_key="pdlx_q")
+        # beyond max_resident=4 the tail pages out; wait for TTL + sweep
+        await asyncio.sleep(0.1)
+        assert srv.broker.resident_bytes < sum(len(b) for b in bodies)
+        got = []
+        deadline = asyncio.get_event_loop().time() + 8
+        while (len(got) < len(bodies)
+               and asyncio.get_event_loop().time() < deadline):
+            m = await ch.basic_get("pdlx_dlq", no_ack=True)
+            if m is None:
+                await asyncio.sleep(0.05)
+                continue
+            got.append(m)
+        assert sorted(m.body for m in got) == sorted(bodies)
+        for m in got:
+            assert m.properties.headers["x-death"][0]["reason"] == "expired"
+        await c.close()
+    finally:
+        await srv.stop()
