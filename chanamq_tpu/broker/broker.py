@@ -282,7 +282,10 @@ class Broker:
         # (the reference streams per-entity, selectQueue on activation) —
         # and bodies load only for the resident head (select_message_metas
         # skips the body column)
-        limit = self.queue_max_resident or len(entries)
+        watermark = (queue.max_resident_override
+                     if queue.max_resident_override is not None
+                     else self.queue_max_resident)
+        limit = watermark or len(entries)
         resident_ids = set(m for (_, m, _, _) in entries[:limit])
         max_offset = sq.last_consumed
         for start in range(0, len(entries), self.RECOVER_META_CHUNK):
@@ -626,6 +629,10 @@ class Broker:
             raise BrokerError(
                 ErrorCode.PRECONDITION_FAILED,
                 "only x-overflow=drop-head is supported")
+        mode = arguments.get("x-queue-mode")
+        if mode is not None and mode not in ("default", "lazy"):
+            raise BrokerError(
+                ErrorCode.PRECONDITION_FAILED, "invalid x-queue-mode")
 
     async def bind_queue(
         self, vhost_name: str, queue_name: str, exchange_name: str,

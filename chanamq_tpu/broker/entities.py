@@ -28,7 +28,7 @@ import asyncio
 import logging
 import time
 from collections import deque
-from typing import TYPE_CHECKING, Any, Optional
+from typing import TYPE_CHECKING, Any, Callable, Optional
 
 from ..amqp.properties import BasicProperties
 from ..store.api import StoredMessage
@@ -154,6 +154,13 @@ class Delivery:
 class Queue:
     """One message queue within a vhost."""
 
+    HYDRATE_BATCH = 128
+    # resident head kept in RAM for x-queue-mode=lazy queues: exactly one
+    # dispatch hydration batch, so the consumer never stalls on an empty
+    # resident head (defined in terms of HYDRATE_BATCH to keep the
+    # invariant under tuning)
+    LAZY_RESIDENT = HYDRATE_BATCH
+
     def __init__(
         self,
         broker: "Broker",
@@ -184,6 +191,11 @@ class Queue:
         self.max_length: Optional[int] = args.get("x-max-length")
         self.max_length_bytes: Optional[int] = args.get("x-max-length-bytes")
         self.expires_ms: Optional[int] = args.get("x-expires")
+        # x-queue-mode=lazy (RabbitMQ lazy queues): page bodies out beyond
+        # a small resident head instead of the broker-wide watermark —
+        # maps straight onto the passivation machinery
+        self.max_resident_override: Optional[int] = (
+            self.LAZY_RESIDENT if args.get("x-queue-mode") == "lazy" else None)
         self.last_used = now_ms()
         # body bytes across READY messages (limit enforcement + gauge)
         self.ready_bytes = 0
@@ -284,7 +296,9 @@ class Queue:
         # transient bodies are written now, flagged paged-not-persisted so
         # no durability promise attaches and recovery never resurrects
         # them. Dispatch hydrates either kind back on demand.
-        max_resident = self.broker.queue_max_resident
+        max_resident = (self.max_resident_override
+                        if self.max_resident_override is not None
+                        else self.broker.queue_max_resident)
         if (max_resident and len(self.messages) > max_resident
                 and message.body is not None):
             if not (message.persisted or message.paged):
@@ -443,8 +457,6 @@ class Queue:
                 self.vhost, self.name, new_unacks)
 
     # -- passivation / hydration -------------------------------------------
-
-    HYDRATE_BATCH = 128
 
     def _start_hydration(self) -> None:
         if self._hydrating or self.deleted:
@@ -835,7 +847,7 @@ class VHost:
     def route(
         self, exchange_name: str, routing_key: str,
         headers: Optional[dict] = None,
-        queue_exists: Optional[Any] = None,
+        queue_exists: Optional[Callable[[str], bool]] = None,
     ) -> Optional[set[str]]:
         """Resolve target queue names; None when the exchange doesn't exist.
 
