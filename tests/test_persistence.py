@@ -508,3 +508,114 @@ async def test_store_synchronous_knob(tmp_path):
 
     with pytest.raises(ValueError):
         SqliteStore(str(tmp_path / "bad.db"), synchronous="SOMETIMES")
+
+
+async def test_sigkill_crash_loop_loses_no_confirmed_message(tmp_path):
+    """Single-node durability under repeated hard crashes: a confirm-mode
+    publisher records every CONFIRMED persistent message; SIGKILL the broker
+    process mid-flow three times; after the final recovery, every confirmed
+    message is present exactly once, in order (confirms may lag — unconfirmed
+    messages may or may not survive, but confirmed ones MUST)."""
+    import signal
+    import socket
+    import subprocess
+    import sys
+
+    db = str(tmp_path / "crash.db")
+    port_holder = {}
+
+    async def start_broker():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "chanamq_tpu.broker.server",
+             "--host", "127.0.0.1", "--port", str(port), "--store", db,
+             "--no-admin", "--log-level", "WARNING"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for _ in range(150):
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"broker died at startup (rc={proc.returncode})")
+            try:
+                _, w = await asyncio.open_connection("127.0.0.1", port)
+                w.close()
+                break
+            except OSError:
+                await asyncio.sleep(0.05)
+        else:
+            proc.kill()
+            raise RuntimeError("broker never came up")
+        port_holder["port"] = port
+        return proc
+
+    confirmed: list[int] = []
+    seq = 0
+
+    async def publish_some(n):
+        """Publish n persistent messages; record exactly the seqs whose
+        confirm arrived (tags are 1-based per fresh channel, and this
+        broker never Basic.Nacks — a failed barrier hard-closes instead —
+        so a tag absent from ch.unconfirmed IS a durable confirm)."""
+        nonlocal seq
+        c = await AMQPClient.connect("127.0.0.1", port_holder["port"])
+        ch = await c.channel()
+        await ch.confirm_select()
+        await ch.queue_declare("crash_q", durable=True)
+        tag_to_seq = {}
+        for _ in range(n):
+            tag = ch.basic_publish(seq.to_bytes(8, "big"),
+                                   routing_key="crash_q",
+                                   properties=PERSISTENT)
+            tag_to_seq[tag] = seq
+            seq += 1
+        try:
+            await ch.wait_unconfirmed_below(1, timeout=10)
+        except Exception:
+            pass  # crash raced the confirms; count what actually arrived
+        pending = set(ch.unconfirmed)
+        confirmed.extend(s for t, s in tag_to_seq.items() if t not in pending)
+        try:
+            await c.close()
+        except Exception:
+            pass
+
+    proc = await start_broker()
+    try:
+        for round_no in range(3):
+            await publish_some(400)
+            # crash mid-life: some publishes of the NEXT burst race the kill
+            burst = asyncio.create_task(publish_some(200))
+            await asyncio.sleep(0.05)
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            try:
+                await asyncio.wait_for(burst, timeout=10)
+            except asyncio.TimeoutError:
+                burst.cancel()
+            except (OSError, ConnectionError):
+                pass  # connect lost the race with the kill: nothing published
+            proc = await start_broker()
+        # final recovery: drain and check every confirmed id is present
+        # exactly once, in order
+        c = await AMQPClient.connect("127.0.0.1", port_holder["port"])
+        ch = await c.channel()
+        got = []
+        while True:
+            m = await ch.basic_get("crash_q", no_ack=True)
+            if m is None:
+                break
+            got.append(int.from_bytes(m.body, "big"))
+        confirmed_set = set(confirmed)
+        present = [g for g in got if g in confirmed_set]
+        assert len(got) == len(set(got)), "duplicate delivery after recovery"
+        assert confirmed_set.issubset(set(got)), (
+            f"lost {sorted(confirmed_set - set(got))[:10]} confirmed messages")
+        assert present == sorted(present), "confirmed messages out of order"
+        await c.close()
+    finally:
+        try:
+            proc.kill()
+            proc.wait(timeout=5)
+        except Exception:
+            pass
