@@ -286,7 +286,12 @@ class Broker:
                      if queue.max_resident_override is not None
                      else self.queue_max_resident)
         limit = watermark or len(entries)
-        resident_ids = set(m for (_, m, _, _) in entries[:limit])
+        prio_mode = queue.max_priority is not None
+        # priority queues: the post-sort head — not the lowest offsets — is
+        # what dispatch serves first, so body loading waits until after the
+        # sort below; plain queues keep the streaming offset-order load
+        resident_ids = (set() if prio_mode
+                        else set(m for (_, m, _, _) in entries[:limit]))
         max_offset = sq.last_consumed
         for start in range(0, len(entries), self.RECOVER_META_CHUNK):
             chunk = entries[start:start + self.RECOVER_META_CHUNK]
@@ -314,6 +319,32 @@ class Broker:
                     queue._passivated.append(qm)
                 max_offset = max(max_offset, offset)
         queue.next_offset = max_offset + 1
+        if prio_mode:
+            # priority queues recover into (priority desc, offset) order;
+            # each entry's priority comes from its recovered properties
+            for qm in queue.messages:
+                qm.priority = min(
+                    qm.message.properties.priority or 0, queue.max_priority)
+            ordered = sorted(queue.messages,
+                             key=lambda q: (-q.priority, q.offset))
+            queue.messages.clear()
+            queue.messages.extend(ordered)
+            # now load bodies for the SORTED head (what dispatch serves
+            # first) and rebuild the passivated deque in matching order so
+            # hydration batches align with the queue head
+            head = ordered[:limit]
+            head_bodies = await self.store.select_messages(
+                [qm.message.id for qm in head])
+            for qm in head:
+                sm = head_bodies.get(qm.message.id)
+                if sm is not None and qm.message.body is None:
+                    qm.message.body = sm.body
+                    if qm.message.header_raw is None:
+                        qm.message.header_raw = sm.properties_raw
+                    self.account_message(qm.message)
+            queue._passivated.clear()
+            queue._passivated.extend(
+                qm for qm in ordered if qm.message.body is None)
         queue.ready_bytes = sum(q.body_size for q in queue.messages)
         if sq.unacks:
             # Recovered unacks re-enter the queue as ready messages. They
@@ -633,6 +664,15 @@ class Broker:
         if mode is not None and mode not in ("default", "lazy"):
             raise BrokerError(
                 ErrorCode.PRECONDITION_FAILED, "invalid x-queue-mode")
+        max_prio = arguments.get("x-max-priority")
+        if max_prio is not None and (
+                not isinstance(max_prio, int) or not 1 <= max_prio <= 255):
+            raise BrokerError(
+                ErrorCode.PRECONDITION_FAILED, "invalid x-max-priority")
+        if max_prio is not None and mode == "lazy":
+            raise BrokerError(
+                ErrorCode.PRECONDITION_FAILED,
+                "x-max-priority cannot combine with x-queue-mode=lazy")
 
     async def bind_queue(
         self, vhost_name: str, queue_name: str, exchange_name: str,

@@ -110,7 +110,7 @@ class QueuedMessage:
     (paged out to the store, reference: MessageEntity.scala:168-198)."""
 
     __slots__ = ("message", "offset", "expire_at_ms", "redelivered",
-                 "body_size", "dead")
+                 "body_size", "dead", "priority")
 
     def __init__(
         self, message: Message, offset: int, expire_at_ms: Optional[int],
@@ -124,6 +124,8 @@ class QueuedMessage:
         # set when hydration finds the stored blob gone (TTL'd / deleted):
         # dispatch and pop discard dead entries
         self.dead = False
+        # effective message priority (priority queues only; 0 elsewhere)
+        self.priority = 0
 
     def is_expired(self, now: Optional[int] = None) -> bool:
         return self.expire_at_ms is not None and (now or now_ms()) >= self.expire_at_ms
@@ -196,6 +198,13 @@ class Queue:
         # maps straight onto the passivation machinery
         self.max_resident_override: Optional[int] = (
             self.LAZY_RESIDENT if args.get("x-queue-mode") == "lazy" else None)
+        # x-max-priority (RabbitMQ priority queues): ready messages order by
+        # (priority desc, offset) instead of plain FIFO. Because consumption
+        # then leaves offset order, the lastConsumed watermark cannot prune
+        # the durable queue log — settles delete their rows individually
+        # (coalesced per tick) and recovery replays whatever rows remain.
+        self.max_priority: Optional[int] = args.get("x-max-priority")
+        self._row_del_buf: list[int] = []
         self.last_used = now_ms()
         # body bytes across READY messages (limit enforcement + gauge)
         self.ready_bytes = 0
@@ -269,7 +278,12 @@ class Queue:
         qm = QueuedMessage(message, self.next_offset, self.clamp_expiry(message),
                            body_size=body_size)
         self.next_offset += 1
-        self.messages.append(qm)
+        if self.max_priority is None:
+            self.messages.append(qm)
+        else:
+            qm.priority = min(message.properties.priority or 0,
+                              self.max_priority)
+            self._insert_by_priority(qm)
         self.ready_bytes += qm.body_size
         if self.durable and message.persisted:
             self.broker.store.insert_queue_msg_nowait(
@@ -281,8 +295,7 @@ class Queue:
         # rejects others). Runs before passivation so a dropped entry is
         # never paged out.
         if self.max_length is not None or self.max_length_bytes is not None:
-            self._drop_overflow()
-            if not self.messages or self.messages[-1] is not qm:
+            if self._drop_overflow(watch=qm):
                 # the pushed entry itself overflowed (tiny cap): it is
                 # settled, so skip passivation and just wake dispatch
                 self.schedule_dispatch()
@@ -322,22 +335,76 @@ class Queue:
         self.schedule_dispatch()
         return qm
 
-    def _drop_overflow(self) -> None:
+    def _requeue_priority(self, qm: QueuedMessage) -> None:
+        """Requeue into (priority desc, offset asc) position. Durable
+        bookkeeping: the dispatch that delivered this entry buffered a
+        delete of its queue-log row — if that delete has NOT flushed yet,
+        cancel it (the row is still there) instead of re-inserting behind
+        it, which would let the flush erase the re-inserted row."""
+        messages = self.messages
+        i = len(messages)
+        for idx, existing in enumerate(messages):
+            if (existing.priority < qm.priority
+                    or (existing.priority == qm.priority
+                        and existing.offset > qm.offset)):
+                i = idx
+                break
+        if i == len(messages):
+            messages.append(qm)
+        else:
+            messages.insert(i, qm)
+        if self.durable and qm.message.persisted:
+            try:
+                self._row_del_buf.remove(qm.offset)
+                row_present = True
+            except ValueError:
+                row_present = False
+            self.broker.store_bg(
+                self.broker.store.delete_queue_unacks(
+                    self.vhost, self.name, [qm.message.id]))
+            if not row_present:
+                self.broker.store_bg(
+                    self.broker.store.insert_queue_msg(
+                        self.vhost, self.name, qm.offset, qm.message.id,
+                        qm.body_size, qm.expire_at_ms))
+
+    def _insert_by_priority(self, qm: QueuedMessage) -> None:
+        """Ready-set ordering for priority queues: (priority desc, offset).
+        Scanned from the tail — same-or-lower priority than the tail (the
+        overwhelmingly common flat-priority flow) is a plain append."""
+        messages = self.messages
+        n = len(messages)
+        i = n
+        while i > 0 and messages[i - 1].priority < qm.priority:
+            i -= 1
+        if i == n:
+            messages.append(qm)
+        else:
+            messages.insert(i, qm)
+
+    def _drop_overflow(self, watch: Optional[QueuedMessage] = None) -> bool:
         """Enforce x-max-length / x-max-length-bytes by dropping from the
         head (oldest first), dead-lettering each victim (RabbitMQ
-        drop-head semantics: the cap bounds READY messages)."""
+        drop-head semantics: the cap bounds READY messages). Returns True
+        if `watch` (the just-pushed entry) was among the victims — identity
+        is tracked explicitly because a priority insert may land anywhere,
+        not just at the tail."""
         messages = self.messages
+        dropped_watch = False
         while messages and (
             (self.max_length is not None and len(messages) > self.max_length)
             or (self.max_length_bytes is not None
                 and self.ready_bytes > self.max_length_bytes)
         ):
             qm = messages.popleft()
+            if qm is watch:
+                dropped_watch = True
             self.ready_bytes -= qm.body_size
             self._advance_watermark(qm)
             self._settle_dead(qm, "maxlen")
         if self._passivated:
             self._prune_passivated()
+        return dropped_watch
 
     def _settle_dead(self, qm: QueuedMessage, reason: str) -> None:
         """A message died in this queue (expired / rejected / overflowed):
@@ -376,6 +443,16 @@ class Queue:
 
 
     def _advance_watermark(self, qm: QueuedMessage) -> None:
+        if self.max_priority is not None:
+            # priority queues consume out of offset order: the watermark
+            # cannot prune, so each settled entry deletes its own row
+            # (coalesced into one executemany per loop tick)
+            if self.durable and qm.message.persisted and not self.deleted:
+                buf = self._row_del_buf
+                buf.append(qm.offset)
+                if len(buf) == 1:
+                    asyncio.get_event_loop().call_soon(self._flush_row_deletes)
+            return
         if qm.offset > self.last_consumed:
             self.last_consumed = qm.offset
             if self.durable and not self._wm_dirty:
@@ -384,6 +461,13 @@ class Queue:
                 # any requeue rewind in between)
                 self._wm_dirty = True
                 asyncio.get_event_loop().call_soon(self._persist_watermark)
+
+    def _flush_row_deletes(self) -> None:
+        offsets, self._row_del_buf = self._row_del_buf, []
+        if offsets and not self.deleted:
+            self.broker.store_bg(
+                self.broker.store.delete_queue_msgs_offsets(
+                    self.vhost, self.name, offsets))
 
     def _persist_watermark(self) -> None:
         self._wm_dirty = False
@@ -400,6 +484,8 @@ class Queue:
         if self._wm_dirty:
             self._persist_watermark()
         self._flush_unack_deletes()
+        if self._row_del_buf:
+            self._flush_row_deletes()
 
     def schedule_dispatch(self) -> None:
         if self._dispatch_scheduled or self.deleted:
@@ -684,11 +770,19 @@ class Queue:
                 )
             self._settle_dead(qm, "expired")
             return
+        self.ready_bytes += qm.body_size
+        if self.max_priority is not None:
+            # priority queues: back into the (priority desc, offset) order;
+            # durably, the dispatch deleted this entry's row, so settle the
+            # unack row and re-insert the queue-log row (FIFO store thread
+            # keeps the pair ordered)
+            self._requeue_priority(qm)
+            self.schedule_dispatch()
+            return
         # insert keeping offset order. Requeues nearly always precede the
         # whole backlog (they were at the head when delivered), so the O(1)
         # end checks cover the hot cases; the linear scan is the rare
         # interleaved-offset fallback.
-        self.ready_bytes += qm.body_size
         if not self.messages or qm.offset < self.messages[0].offset:
             self.messages.appendleft(qm)
         elif qm.offset > self.messages[-1].offset:
@@ -735,6 +829,9 @@ class Queue:
         self.messages.clear()
         self.ready_bytes = 0
         self._passivated.clear()
+        # purge_queue_msgs below supersedes any per-row deletes buffered by
+        # _advance_watermark for the purged entries (priority queues)
+        self._row_del_buf.clear()
         if self.durable:
             self.broker.store_bg(
                 self.broker.store.purge_queue_msgs(self.vhost, self.name)

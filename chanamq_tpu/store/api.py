@@ -231,6 +231,14 @@ class StoreService:
         """unacks: (msg_id, offset, body_size, expire_at_ms|None)."""
         raise NotImplementedError
 
+    async def delete_queue_msgs_offsets(
+        self, vhost: str, queue: str, offsets: list[int]
+    ) -> None:
+        """Remove specific queue-log rows by offset. Priority queues settle
+        per-row (consumption is not in offset order, so the lastConsumed
+        watermark cannot prune for them)."""
+        raise NotImplementedError
+
     async def delete_queue_unacks(
         self, vhost: str, queue: str, msg_ids: list[int]
     ) -> None:

@@ -126,6 +126,13 @@ class MemoryStore(StoreService):
                 q.unacks[msg_id] = (offset, body_size, expire_at_ms)
         return _DONE
 
+    def delete_queue_msgs_offsets(self, vhost, queue, offsets):
+        q = self.queues.get((vhost, queue))
+        if q:
+            drop = set(offsets)
+            q.msgs = [m for m in q.msgs if m[0] not in drop]
+        return _DONE
+
     def delete_queue_unacks(self, vhost, queue, msg_ids):
         q = self.queues.get((vhost, queue))
         if q:

@@ -579,6 +579,11 @@ class SqliteStore(StoreService):
     def insert_queue_unacks_nowait(self, vhost, queue, unacks) -> None:
         self._submit_nowait(self._insert_queue_unacks_op(vhost, queue, unacks))
 
+    def delete_queue_msgs_offsets(self, vhost, queue, offsets):
+        return self._submit(lambda db: db.executemany(
+            "DELETE FROM queue_msgs WHERE vhost=? AND queue=? AND offset=?",
+            [(vhost, queue, o) for o in offsets]), guard=False)
+
     def delete_queue_unacks(self, vhost, queue, msg_ids):
         return self._submit(lambda db: db.executemany(
             "DELETE FROM queue_unacks WHERE vhost=? AND queue=? AND msg_id=?",
