@@ -943,3 +943,52 @@ async def test_alternate_exchange_to_default_reaches_remote_queue(tmp_path):
     finally:
         for node in nodes:
             await node.stop()
+
+
+async def test_priority_queue_ordering_on_remote_owner(tmp_path):
+    """x-max-priority replicates with the queue metadata: publishes routed
+    to a remote owner are ordered by priority there, and a consumer on the
+    origin node receives them highest-first."""
+    nodes = await start_cluster(tmp_path, 2)
+    try:
+        name = None
+        for i in range(100):
+            cand = f"pr_rc_q{i}"
+            if nodes[0].cluster.queue_owner("/", cand) == nodes[1].name:
+                name = cand
+                break
+        assert name is not None
+        c0 = await AMQPClient.connect("127.0.0.1", nodes[0].port)
+        ch0 = await c0.channel()
+        await ch0.queue_declare(name, durable=True,
+                                arguments={"x-max-priority": 9})
+        await asyncio.sleep(0.2)
+        for body, p in ((b"low-a", 1), (b"high-a", 9), (b"low-b", 1),
+                        (b"high-b", 9)):
+            ch0.basic_publish(body, routing_key=name, properties=BasicProperties(
+                priority=p, delivery_mode=2))
+        # ordering barrier via the owner
+        c1 = await AMQPClient.connect("127.0.0.1", nodes[1].port)
+        ch1 = await c1.channel()
+        for _ in range(100):
+            ok = await ch1.queue_declare(name, passive=True)
+            if ok.message_count == 4:
+                break
+            await asyncio.sleep(0.02)
+        assert ok.message_count == 4
+        got = []
+        done = asyncio.get_event_loop().create_future()
+
+        def cb(m):
+            got.append(m.body)
+            if len(got) == 4 and not done.done():
+                done.set_result(None)
+
+        await ch0.basic_consume(name, cb, no_ack=True)
+        await asyncio.wait_for(done, 10)
+        assert got == [b"high-a", b"high-b", b"low-a", b"low-b"]
+        await c0.close()
+        await c1.close()
+    finally:
+        for node in nodes:
+            await node.stop()
