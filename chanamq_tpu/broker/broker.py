@@ -57,6 +57,7 @@ class Broker:
         queue_max_resident: int = 16384,
         memory_high_watermark: int = 0,
         memory_low_watermark: Optional[int] = None,
+        consumer_timeout_ms: int = 0,
     ) -> None:
         self.store = store or MemoryStore()
         self.idgen = IdGenerator(node_id)
@@ -86,6 +87,10 @@ class Broker:
                 "memory low watermark %d >= high %d; clamping to 80%% of high",
                 self.memory_low_watermark, self.memory_high_watermark)
             self.memory_low_watermark = int(self.memory_high_watermark * 0.8)
+        # ack timeout (chana.mq.consumer.timeout; RabbitMQ consumer_timeout,
+        # default 30min there): a delivery unacked past this closes its
+        # channel with PRECONDITION_FAILED and requeues. 0 disables.
+        self.consumer_timeout_ms = consumer_timeout_ms or 0
         self.blocked = False
         self._memory_gate = asyncio.Event()
         self._memory_gate.set()
@@ -93,6 +98,13 @@ class Broker:
         # Connection.Blocked/Unblocked to capable clients — an extension
         # the reference never implemented, README.md:10-22)
         self.blocked_listeners: set[Any] = set()
+        # live AMQPConnections (registered by serve()): the ack-timeout
+        # sweep walks their channels' unacked maps — the one place EVERY
+        # outstanding delivery appears, local or remotely-owned
+        self.connections: set[Any] = set()
+        # strong refs to fire-and-forget tasks (event loops hold tasks only
+        # weakly; an unreferenced task can be GC'd before it runs)
+        self._bg_tasks: set[asyncio.Task] = set()
         self._sweep_task: Optional[asyncio.Task] = None
         self._msg_delete_buf: list[int] = []
         self._started = False
@@ -119,6 +131,13 @@ class Broker:
         """Topology changed: cached publish routes are stale."""
         if self._route_cache:
             self._route_cache.clear()
+
+    def spawn(self, coro: Awaitable) -> None:
+        """Fire-and-forget a coroutine with a strong reference held until
+        it finishes (the loop alone keeps only a weak ref)."""
+        task = asyncio.get_event_loop().create_task(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     def account_memory(self, delta: int) -> None:
         """Track resident message-body bytes (passivation drops, hydration
@@ -184,6 +203,13 @@ class Broker:
             await self.create_vhost(DEFAULT_VHOST)
         if self.message_sweep_interval_s > 0:
             self._sweep_task = asyncio.create_task(self._sweep_loop())
+        elif self.consumer_timeout_ms:
+            # enforcement piggybacks on the sweep: without it the timeout
+            # is inert — say so instead of silently not protecting
+            log.warning(
+                "chana.mq.consumer.timeout is set but the sweep is disabled "
+                "(chana.mq.message.sweep-interval <= 0): ack timeouts will "
+                "NOT be enforced")
         self._started = True
 
     async def stop(self) -> None:
@@ -858,7 +884,7 @@ class Broker:
             except Exception:
                 log.exception("auto-delete of queue %s failed", queue_name)
 
-        asyncio.get_event_loop().create_task(_delete())
+        self.spawn(_delete())
 
     # -- dead-lettering (no reference analogue: RabbitMQ-style DLX) --------
 
@@ -915,7 +941,7 @@ class Broker:
         new_props.expiration = None
         routing_key = queue.dlx_rk if queue.dlx_rk is not None else msg.routing_key
         self.metrics.dead_lettered_msgs += 1
-        asyncio.get_event_loop().create_task(self._dead_letter_publish(
+        self.spawn(self._dead_letter_publish(
             queue.vhost, queue.dlx, routing_key, new_props, msg))
 
     async def _dead_letter_publish(
@@ -1262,6 +1288,8 @@ class Broker:
                 await asyncio.sleep(self.message_sweep_interval_s)
                 now = now_ms()
                 expired_queues: list[Queue] = []
+                overdue_channels: set = set()
+                timeout = self.consumer_timeout_ms
                 for vhost in self.vhosts.values():
                     for queue in vhost.queues.values():
                         before = len(queue.messages)
@@ -1272,10 +1300,27 @@ class Broker:
                         if (queue.expires_ms and not queue.consumers
                                 and now - queue.last_used >= queue.expires_ms):
                             expired_queues.append(queue)
+                if timeout:
+                    # ack timeout: walk every live connection's channels —
+                    # the one registry where every outstanding delivery
+                    # appears (local consume/get AND remotely-owned queues)
+                    for conn in list(self.connections):
+                        for channel in list(conn.channels.values()):
+                            if channel.closed:
+                                continue
+                            if any(now - d.delivered_at_ms > timeout
+                                   for d in channel.unacked.values()):
+                                overdue_channels.add(channel)
                 for queue in expired_queues:
                     log.info("queue %s idle-expired (x-expires=%dms)",
                              queue.name, queue.expires_ms)
                     self.schedule_queue_delete(
                         queue.vhost, queue.name, only_if_idle=True)
+                for channel in overdue_channels:
+                    log.warning(
+                        "channel %d: delivery ack timeout (%d ms), closing",
+                        channel.id, timeout)
+                    self.spawn(
+                        channel.connection.close_channel_ack_timeout(channel))
         except asyncio.CancelledError:
             pass

@@ -263,6 +263,7 @@ class AMQPConnection:
         self.broker.metrics.connections_opened += 1
         self._writer_task = asyncio.create_task(self._writer_loop())
         self.broker.blocked_listeners.add(self._on_memory_blocked)
+        self.broker.connections.add(self)
         try:
             await self._handshake()
             await self._main_loop()
@@ -274,6 +275,7 @@ class AMQPConnection:
             log.exception("connection %d crashed", self.id)
         finally:
             self.broker.blocked_listeners.discard(self._on_memory_blocked)
+            self.broker.connections.discard(self)
             await self._teardown()
 
     def _on_memory_blocked(self, blocked: bool) -> None:
@@ -660,6 +662,16 @@ class AMQPConnection:
             reply_code=int(exc.code), reply_text=exc.text[:255],
             class_id=exc.class_id, method_id=exc.method_id,
         ))
+
+    async def close_channel_ack_timeout(self, channel: ServerChannel) -> None:
+        """Sweep-detected delivery-ack timeout (chana.mq.consumer.timeout):
+        close just the channel — release_all requeues its unacked — with
+        the PRECONDITION_FAILED the RabbitMQ consumer_timeout uses."""
+        if self.closing or channel.closed or channel.id not in self.channels:
+            return
+        await self._soft_close_channel(channel.id, ChannelError(
+            ErrorCode.PRECONDITION_FAILED,
+            "delivery acknowledgement timeout"))
 
     async def _teardown(self) -> None:
         self.closing = True

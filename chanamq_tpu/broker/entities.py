@@ -134,7 +134,8 @@ class QueuedMessage:
 class Delivery:
     """An unacked delivery: the link channel<->queue for one message."""
 
-    __slots__ = ("queued", "queue", "channel", "consumer_tag", "delivery_tag", "no_ack")
+    __slots__ = ("queued", "queue", "channel", "consumer_tag", "delivery_tag",
+                 "no_ack", "delivered_at_ms")
 
     def __init__(
         self,
@@ -151,6 +152,10 @@ class Delivery:
         self.consumer_tag = consumer_tag
         self.delivery_tag = delivery_tag
         self.no_ack = no_ack
+        # ack-timeout clock (chana.mq.consumer.timeout; RabbitMQ's
+        # consumer_timeout): a delivery unacked past the deadline closes
+        # its channel so a stuck consumer can't pin messages forever
+        self.delivered_at_ms = now_ms()
 
 
 class Queue:

@@ -189,6 +189,7 @@ class BrokerServer:
         heartbeat = config.duration_s("chana.mq.amqp.connection.heartbeat")
         sweep = config.duration_s("chana.mq.message.sweep-interval")
         low = config.size_bytes("chana.mq.memory.low-watermark")
+        ack_timeout = config.duration_s("chana.mq.consumer.timeout")
         broker = Broker(
             store=store,
             message_sweep_interval_s=sweep if sweep is not None else 0.0,
@@ -196,6 +197,8 @@ class BrokerServer:
             memory_high_watermark=config.size_bytes(
                 "chana.mq.memory.high-watermark") or 0,
             memory_low_watermark=low,
+            consumer_timeout_ms=(
+                int(ack_timeout * 1000) if ack_timeout else 0),
         )
         return cls(
             broker=broker,

@@ -56,6 +56,10 @@ DEFAULTS: dict[str, Any] = {
     # Only consulted when users are configured; a user absent from the map
     # may open ANY vhost (allowlist opt-in per user).
     "chana.mq.auth.permissions": None,
+    # delivery acknowledgement timeout (RabbitMQ consumer_timeout, same
+    # 30-minute default): a delivery unacked past this closes its channel
+    # with PRECONDITION_FAILED and requeues. "infinite" disables.
+    "chana.mq.consumer.timeout": "30m",
     "chana.mq.internal.timeout": "20s",
     "chana.mq.message.inactive": "1h",
     "chana.mq.message.sweep-interval": "1s",

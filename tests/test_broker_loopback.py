@@ -502,3 +502,75 @@ async def test_consumer_cancel_notify_across_connections(server):
     finally:
         await c1.close()
         await c2.close()
+
+
+async def test_consumer_ack_timeout_closes_channel_and_requeues():
+    """chana.mq.consumer.timeout (RabbitMQ consumer_timeout): a delivery
+    unacked past the deadline closes the offending channel with 406 and
+    requeues the messages; other channels are untouched."""
+    from chanamq_tpu.broker.broker import Broker
+
+    broker = Broker(message_sweep_interval_s=0.1, consumer_timeout_ms=300)
+    srv = BrokerServer(broker=broker, host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    try:
+        c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        stuck = await c.channel()
+        healthy = await c.channel()
+        await stuck.queue_declare("at_q")
+        got = []
+        await stuck.basic_consume("at_q", got.append)  # never acks
+        stuck.basic_publish(b"hung", routing_key="at_q")
+        for _ in range(50):
+            if got:
+                break
+            await asyncio.sleep(0.02)
+        assert got, "delivery never arrived"
+        # wait past timeout + sweep: the stuck channel dies with 406
+        err = None
+        for _ in range(100):
+            try:
+                await stuck.queue_declare("at_q", passive=True)
+            except ChannelClosedError as exc:
+                err = exc
+                break
+            await asyncio.sleep(0.05)
+        assert err is not None and err.reply_code == 406
+        assert "timeout" in err.reply_text
+        # the message requeued and the healthy channel can take it
+        m = None
+        for _ in range(100):
+            m = await healthy.basic_get("at_q", no_ack=True)
+            if m is not None:
+                break
+            await asyncio.sleep(0.02)
+        assert m is not None and m.body == b"hung" and m.redelivered
+        await c.close()
+    finally:
+        await srv.stop()
+
+
+async def test_prompt_acks_never_hit_ack_timeout():
+    from chanamq_tpu.broker.broker import Broker
+
+    broker = Broker(message_sweep_interval_s=0.05, consumer_timeout_ms=400)
+    srv = BrokerServer(broker=broker, host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    try:
+        c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        ch = await c.channel()
+        await ch.queue_declare("ok_q")
+
+        def on_msg(m):
+            ch.basic_ack(m.delivery_tag)
+
+        await ch.basic_consume("ok_q", on_msg)
+        for _ in range(10):
+            ch.basic_publish(b"quick", routing_key="ok_q")
+            await asyncio.sleep(0.08)
+        # channel survived well past the timeout window
+        ok = await ch.queue_declare("ok_q", passive=True)
+        assert ok.queue == "ok_q"
+        await c.close()
+    finally:
+        await srv.stop()

@@ -992,3 +992,48 @@ async def test_priority_queue_ordering_on_remote_owner(tmp_path):
     finally:
         for node in nodes:
             await node.stop()
+
+
+async def test_ack_timeout_fires_for_remote_consumers(tmp_path):
+    """The ack-timeout sweep walks channel unacked maps, so a stuck
+    consumer of a REMOTELY-owned queue is timed out by its origin node
+    like any local consumer."""
+    nodes = await start_cluster(tmp_path, 2)
+    try:
+        for node in nodes:
+            node.server.broker.consumer_timeout_ms = 400
+        name = None
+        for i in range(100):
+            cand = f"at_rc_q{i}"
+            if nodes[0].cluster.queue_owner("/", cand) == nodes[1].name:
+                name = cand
+                break
+        assert name is not None
+        c0 = await AMQPClient.connect("127.0.0.1", nodes[0].port)
+        ch0 = await c0.channel()
+        await ch0.queue_declare(name, durable=True)
+        got = []
+        await ch0.basic_consume(name, got.append)  # never acks
+        ch0.basic_publish(b"stuck-remote", routing_key=name,
+                          properties=PERSISTENT)
+        for _ in range(100):
+            if got:
+                break
+            await asyncio.sleep(0.02)
+        assert got, "remote delivery never arrived"
+        # origin sweep (1s default interval) times the channel out
+        from chanamq_tpu.client.client import ChannelClosedError
+
+        err = None
+        for _ in range(120):
+            try:
+                await ch0.queue_declare(name, passive=True)
+            except ChannelClosedError as exc:
+                err = exc
+                break
+            await asyncio.sleep(0.05)
+        assert err is not None and err.reply_code == 406, err
+        await c0.close()
+    finally:
+        for node in nodes:
+            await node.stop()
