@@ -690,6 +690,11 @@ class Broker:
         if mode is not None and mode not in ("default", "lazy"):
             raise BrokerError(
                 ErrorCode.PRECONDITION_FAILED, "invalid x-queue-mode")
+        sac = arguments.get("x-single-active-consumer")
+        if sac is not None and not isinstance(sac, bool):
+            raise BrokerError(
+                ErrorCode.PRECONDITION_FAILED,
+                "invalid x-single-active-consumer")
         max_prio = arguments.get("x-max-priority")
         if max_prio is not None and (
                 not isinstance(max_prio, int) or not 1 <= max_prio <= 255):

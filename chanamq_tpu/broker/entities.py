@@ -210,6 +210,10 @@ class Queue:
         # (coalesced per tick) and recovery replays whatever rows remain.
         self.max_priority: Optional[int] = args.get("x-max-priority")
         self._row_del_buf: list[int] = []
+        # x-single-active-consumer (RabbitMQ SAC): deliveries go only to
+        # the longest-registered consumer; when it cancels or dies the
+        # next registrant takes over automatically
+        self.single_active = bool(args.get("x-single-active-consumer"))
         self.last_used = now_ms()
         # body bytes across READY messages (limit enforcement + gauge)
         self.ready_bytes = 0
@@ -655,6 +659,17 @@ class Queue:
         With x-priority consumers present (RabbitMQ extension), higher
         priorities are served first while they have budget, round-robin
         within a level; the flat fast path is untouched otherwise."""
+        if self.single_active:
+            # SAC: one active consumer — the highest x-priority, earliest-
+            # registered within that level (RabbitMQ 3.12+ activates by
+            # priority); plain SAC queues use pure registration order
+            if not self.consumers:
+                return None
+            if self._prio_groups is not None:
+                consumer = self._prio_groups[0][0][0]
+            else:
+                consumer = self.consumers[0]
+            return consumer if consumer.can_take(size) else None
         if self._prio_groups is not None:
             return self._next_by_priority(size)
         n = len(self.consumers)
@@ -860,6 +875,10 @@ class Queue:
             return False
         if self._prio_groups is not None:
             self._rebuild_prio_groups()
+        if self.single_active and self.consumers:
+            # SAC succession: the next-longest-registered consumer takes
+            # over the backlog immediately
+            self.schedule_dispatch()
         self.last_used = now_ms()
         if self.auto_delete and self.had_consumer and not self.consumers:
             return True

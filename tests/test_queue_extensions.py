@@ -419,3 +419,89 @@ async def test_consumer_priority_round_robin_within_level(server):
     finally:
         await c_hi.close()
         await c_lo.close()
+
+
+# -- single-active consumer (x-single-active-consumer) ----------------------
+
+
+async def test_single_active_consumer_exclusive_delivery_and_takeover(server):
+    """SAC: only the longest-registered consumer receives; cancelling it
+    hands the queue to the next registrant, and a consumer-connection
+    death does the same."""
+    from chanamq_tpu.client import AMQPClient as _C
+
+    c1 = await _C.connect("127.0.0.1", server.bound_port)
+    c2 = await _C.connect("127.0.0.1", server.bound_port)
+    c3 = await _C.connect("127.0.0.1", server.bound_port)
+    try:
+        setup = await c1.channel()
+        await setup.queue_declare("sac_q", arguments={
+            "x-single-active-consumer": True})
+        a_got, b_got, c_got = [], [], []
+        ch_a = await c1.channel()
+        tag_a = await ch_a.basic_consume("sac_q", a_got.append, no_ack=True)
+        ch_b = await c2.channel()
+        await ch_b.basic_consume("sac_q", b_got.append, no_ack=True)
+        ch_c = await c3.channel()
+        await ch_c.basic_consume("sac_q", c_got.append, no_ack=True)
+
+        for i in range(6):
+            setup.basic_publish(b"m%d" % i, routing_key="sac_q")
+        await asyncio.sleep(0.2)
+        assert len(a_got) == 6 and not b_got and not c_got
+
+        # cancel the active consumer: B takes over
+        await ch_a.basic_cancel(tag_a)
+        setup.basic_publish(b"next", routing_key="sac_q")
+        await asyncio.sleep(0.2)
+        assert [m.body for m in b_got] == [b"next"] and not c_got
+
+        # kill B's connection: C takes over
+        await c2.close()
+        await asyncio.sleep(0.2)
+        setup.basic_publish(b"last", routing_key="sac_q")
+        await asyncio.sleep(0.2)
+        assert [m.body for m in c_got] == [b"last"]
+    finally:
+        await c1.close()
+        await c3.close()
+
+
+async def test_single_active_consumer_validation(client):
+    ch = await client.channel()
+    with pytest.raises(ChannelClosedError) as exc_info:
+        await ch.queue_declare("sac_bad", arguments={
+            "x-single-active-consumer": "yes"})
+    assert exc_info.value.reply_code == 406
+
+
+async def test_single_active_consumer_prefers_highest_priority(server):
+    """SAC + x-priority: the ACTIVE consumer is the highest-priority one
+    (RabbitMQ 3.12+ activation rule), even if registered later."""
+    from chanamq_tpu.client import AMQPClient as _C
+
+    c1 = await _C.connect("127.0.0.1", server.bound_port)
+    c2 = await _C.connect("127.0.0.1", server.bound_port)
+    try:
+        setup = await c1.channel()
+        await setup.queue_declare("sacp_q", arguments={
+            "x-single-active-consumer": True})
+        low_got, high_got = [], []
+        ch_low = await c1.channel()
+        await ch_low.basic_consume("sacp_q", low_got.append, no_ack=True)
+        ch_high = await c2.channel()
+        tag_high = await ch_high.basic_consume(
+            "sacp_q", high_got.append, no_ack=True,
+            arguments={"x-priority": 10})
+        for i in range(4):
+            setup.basic_publish(b"p%d" % i, routing_key="sacp_q")
+        await asyncio.sleep(0.2)
+        assert len(high_got) == 4 and not low_got
+        # cancelling the high-priority active hands back to the low one
+        await ch_high.basic_cancel(tag_high)
+        setup.basic_publish(b"after", routing_key="sacp_q")
+        await asyncio.sleep(0.2)
+        assert [m.body for m in low_got] == [b"after"]
+    finally:
+        await c1.close()
+        await c2.close()
