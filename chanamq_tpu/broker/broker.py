@@ -1308,13 +1308,14 @@ class Broker:
                 if timeout:
                     # ack timeout: walk every live connection's channels —
                     # the one registry where every outstanding delivery
-                    # appears (local consume/get AND remotely-owned queues)
+                    # appears (local consume/get, remotely-owned queues,
+                    # and settles parked in uncommitted transactions)
+                    cutoff = now - timeout
                     for conn in list(self.connections):
                         for channel in list(conn.channels.values()):
                             if channel.closed:
                                 continue
-                            if any(now - d.delivered_at_ms > timeout
-                                   for d in channel.unacked.values()):
+                            if channel.has_delivery_older_than(cutoff):
                                 overdue_channels.add(channel)
                 for queue in expired_queues:
                     log.info("queue %s idle-expired (x-expires=%dms)",

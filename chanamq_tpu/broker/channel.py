@@ -168,6 +168,18 @@ class ServerChannel:
         self._delivery_tag += 1
         return self._delivery_tag
 
+    def has_delivery_older_than(self, cutoff_ms: int) -> bool:
+        """Ack-timeout probe: any outstanding delivery older than the
+        cutoff — including settles parked inside an uncommitted tx (they
+        left `unacked` but still pin the message and its QoS budget)."""
+        for delivery in self.unacked.values():
+            if delivery.delivered_at_ms < cutoff_ms:
+                return True
+        for op in self.tx_ops:
+            if op[0] != "publish" and op[1].delivered_at_ms < cutoff_ms:
+                return True
+        return False
+
     def tag_was_issued(self, tag: int) -> bool:
         """Whether this delivery tag was ever issued on the channel (ack/nack
         validation: an above-range tag is unknown even with multiple=true)."""

@@ -667,7 +667,12 @@ class AMQPConnection:
         """Sweep-detected delivery-ack timeout (chana.mq.consumer.timeout):
         close just the channel — release_all requeues its unacked — with
         the PRECONDITION_FAILED the RabbitMQ consumer_timeout uses."""
-        if self.closing or channel.closed or channel.id not in self.channels:
+        if (self.closing or channel.closed
+                or channel.id in self._closing_channels
+                or self.channels.get(channel.id) is not channel):
+            # already closing (a prior sweep tick's task may still be inside
+            # the close barrier), or the id was reused by a NEW channel —
+            # never double-close or close a stranger
             return
         await self._soft_close_channel(channel.id, ChannelError(
             ErrorCode.PRECONDITION_FAILED,

@@ -574,3 +574,49 @@ async def test_prompt_acks_never_hit_ack_timeout():
         await c.close()
     finally:
         await srv.stop()
+
+
+async def test_ack_timeout_covers_tx_parked_settles():
+    """A consumer that acks inside a transaction it never commits still
+    pins the message — the ack timeout must see the tx-parked delivery and
+    close the channel (implicit rollback requeues it)."""
+    from chanamq_tpu.broker.broker import Broker
+
+    broker = Broker(message_sweep_interval_s=0.1, consumer_timeout_ms=300)
+    srv = BrokerServer(broker=broker, host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    try:
+        c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        ch = await c.channel()
+        await ch.queue_declare("txat_q")
+        ch.basic_publish(b"parked", routing_key="txat_q")
+        msg = None
+        for _ in range(50):
+            msg = await ch.basic_get("txat_q")
+            if msg is not None:
+                break
+            await asyncio.sleep(0.02)
+        assert msg is not None
+        await ch.tx_select()
+        ch.basic_ack(msg.delivery_tag)  # parked in the tx, never committed
+        err = None
+        for _ in range(100):
+            try:
+                await ch.queue_declare("txat_q", passive=True)
+            except ChannelClosedError as exc:
+                err = exc
+                break
+            await asyncio.sleep(0.05)
+        assert err is not None and err.reply_code == 406
+        # implicit rollback requeued it
+        ch2 = await c.channel()
+        m = None
+        for _ in range(100):
+            m = await ch2.basic_get("txat_q", no_ack=True)
+            if m is not None:
+                break
+            await asyncio.sleep(0.02)
+        assert m is not None and m.body == b"parked" and m.redelivered
+        await c.close()
+    finally:
+        await srv.stop()
