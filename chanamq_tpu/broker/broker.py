@@ -65,6 +65,9 @@ class Broker:
         self.vhosts: dict[str, VHost] = {}
         # set by chanamq_tpu.cluster.node.ClusterNode when clustering is on
         self.cluster = None
+        # set by chanamq_tpu.models.service.ForecastService when forecasting
+        # is on (chana.mq.forecast.enabled); admin serves its snapshot
+        self.forecaster = None
         self.message_sweep_interval_s = message_sweep_interval_s
         # per-queue resident watermark: beyond this depth, durable+persistent
         # bodies are paged out to the store (config chana.mq.queue.max-resident,

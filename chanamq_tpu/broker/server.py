@@ -277,6 +277,7 @@ async def run_node(config) -> None:
     server = BrokerServer.from_config(config)
     admin = None
     cluster = None
+    forecaster = None
     started = False
     stop_event = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -318,6 +319,22 @@ async def run_node(config) -> None:
             # seeds): don't open listeners just to tear clients down
             return
         await server.start_listeners()
+        if config.bool("chana.mq.forecast.enabled"):
+            # live-telemetry forecaster (SURVEY.md §7.1's JAX role): samples
+            # metrics on the loop, trains/predicts on a worker thread,
+            # serves GET /admin/forecast + chanamq_forecast_* gauges
+            from ..models.service import ForecastService
+
+            forecaster = ForecastService(
+                server.broker,
+                interval_s=config.duration_s("chana.mq.forecast.interval")
+                or 1.0,
+                train_interval_s=config.duration_s(
+                    "chana.mq.forecast.train-interval") or 30.0,
+                seq_len=config.int("chana.mq.forecast.window"),
+                history=config.int("chana.mq.forecast.history"),
+            )
+            await forecaster.start()
         if config.bool("chana.mq.admin.enabled"):
             admin = AdminServer(
                 server.broker,
@@ -330,6 +347,8 @@ async def run_node(config) -> None:
     finally:
         if admin:
             await admin.stop()
+        if forecaster:
+            await forecaster.stop()
         if cluster:
             await cluster.stop()
         if started:

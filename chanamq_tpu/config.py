@@ -87,6 +87,15 @@ DEFAULTS: dict[str, Any] = {
     # replay); FULL additionally fsyncs every group commit so confirmed
     # messages survive power loss, at a persistent-throughput cost
     "chana.mq.store.synchronous": "NORMAL",
+    # telemetry forecasting (models/service.py): sample broker metrics into
+    # a ring each interval; train/predict the JAX forecaster off the event
+    # loop every train-interval; serve GET /admin/forecast + Prometheus
+    # gauges. Off by default — enabling spins an accelerator workload.
+    "chana.mq.forecast.enabled": False,
+    "chana.mq.forecast.interval": "1s",
+    "chana.mq.forecast.train-interval": "30s",
+    "chana.mq.forecast.window": 64,     # telemetry vectors per model input
+    "chana.mq.forecast.history": 4096,  # ring capacity (vectors retained)
     "chana.mq.cluster.enabled": False,
     "chana.mq.cluster.host": "127.0.0.1",
     "chana.mq.cluster.port": 25672,

@@ -7,25 +7,39 @@ flagship model is a small causal transformer that forecasts per-queue
 traffic (enqueue/dequeue rates, depth) from a sliding window of metrics —
 the kind of capacity/backlog prediction an operator would bolt onto a broker.
 
+Live wiring (models/telemetry.py + models/service.py): a sampler task on
+the broker's event loop feeds a telemetry ring from utils.metrics; a worker
+thread trains/predicts off-path; the admin API serves GET /admin/forecast
+and chanamq_forecast_* Prometheus gauges. Enable with
+chana.mq.forecast.enabled.
+
 TPU-first by construction: bfloat16 matmuls sized for the MXU, static
 shapes, lax.scan-free forward, shardable over a (dp, tp) device mesh via
 NamedSharding annotations (see chanamq_tpu.parallel).
+
+Lazy attribute access: importing this package must NOT import jax — the
+broker imports models.service/models.telemetry (numpy-only) on its event
+loop, and forecaster.py pulls jax at module top. The jax import happens
+only when a forecaster symbol is first touched (the service does that on
+its worker thread).
 """
 
-from .forecaster import (
-    ForecasterConfig,
-    init_params,
-    forward,
-    loss_fn,
-    make_train_step,
-    synthetic_batch,
-)
-
-__all__ = [
+_FORECASTER_SYMBOLS = (
     "ForecasterConfig",
     "init_params",
     "forward",
     "loss_fn",
     "make_train_step",
+    "init_momentum",
     "synthetic_batch",
-]
+)
+
+__all__ = list(_FORECASTER_SYMBOLS)
+
+
+def __getattr__(name: str):
+    if name in _FORECASTER_SYMBOLS:
+        from . import forecaster
+
+        return getattr(forecaster, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -2,9 +2,11 @@
 
 Input: a window of per-tick broker telemetry vectors
 (features: enqueue rate, dequeue rate, queue depth, unacked count, consumer
-count, publish bytes, deliver bytes, confirm rate — produced by
-chanamq_tpu.utils.metrics). Output: the forecast telemetry vector for the
-next tick. Used for backlog/capacity prediction; never on the message path.
+count, publish bytes, deliver bytes, confirm rate — sampled from
+chanamq_tpu.utils.metrics by chanamq_tpu.models.telemetry). Output: the
+forecast telemetry vector for the next tick. Used for backlog/capacity
+prediction; never on the message path. chanamq_tpu.models.service runs the
+live loop: sample -> ring -> off-path train/predict -> /admin/forecast.
 
 Design notes (TPU):
 - all matmuls in bfloat16 with float32 accumulation (MXU native);
@@ -19,7 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -125,13 +127,25 @@ def loss_fn(params: Params, batch: tuple[jnp.ndarray, jnp.ndarray],
     return jnp.mean((pred - y) ** 2)
 
 
-def make_train_step(cfg: ForecasterConfig, lr: float = 1e-3) -> Callable:
+def make_train_step(
+    cfg: ForecasterConfig, lr: float = 1e-3,
+    clip_norm: Optional[float] = 1.0,
+) -> Callable:
     """SGD-with-momentum train step (pure jax, optax-free so the hot path is
     a single fused XLA program). Returns step(params, opt_state, batch) ->
-    (params, opt_state, loss)."""
+    (params, opt_state, loss). Gradients are clipped by global norm: live
+    telemetry has regime switches (idle -> flood) whose spiky loss surface
+    diverges unclipped SGD (observed: NaN within 60 steps on real traffic)."""
 
     def step(params: Params, momentum: Params, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+        if clip_norm is not None:
+            global_sq = sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree_util.tree_leaves(grads))
+            scale = jnp.minimum(
+                1.0, clip_norm * jax.lax.rsqrt(global_sq + 1e-12))
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
         new_momentum = jax.tree_util.tree_map(
             lambda m, g: 0.9 * m + g, momentum, grads)
         new_params = jax.tree_util.tree_map(

@@ -1,0 +1,242 @@
+"""Forecast service: live telemetry -> off-path JAX train/predict -> admin.
+
+Closes the loop models/forecaster.py:1-16 promises (SURVEY.md §7.1's one
+honest JAX role — batch analytics over broker metrics, never on the message
+path):
+
+- a sampler task on the broker's event loop appends one telemetry vector
+  per tick to a TelemetryRing (models/telemetry.py) — numpy only, O(#queues)
+  per tick, no JAX on the loop;
+- every train-interval, a single worker thread (run_in_executor) takes a
+  copy of the ring, z-scores it, runs a few train steps of the causal
+  transformer on sampled (window -> next-vector) pairs, then forwards the
+  newest window to produce the next-tick forecast — denormalized back to
+  real units. The event loop never blocks: JAX compilation and execution
+  happen entirely on the worker thread, and at most one round is in
+  flight;
+- the latest forecast is served by the admin API at GET /admin/forecast
+  and as chanamq_forecast_* Prometheus gauges (rest/admin.py).
+
+Enable with chana.mq.forecast.enabled (off by default: a broker should not
+spin an accelerator workload unless the operator asks for capacity
+forecasting).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import time
+from typing import TYPE_CHECKING, Any, Optional
+
+import numpy as np
+
+from .telemetry import (
+    FEATURES, TelemetryRing, counter_state, normalization, sample,
+    training_batch,
+)
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..broker.broker import Broker
+
+log = logging.getLogger("chanamq.forecast")
+
+
+class ForecastService:
+    """Samples broker telemetry and maintains a next-tick forecast."""
+
+    def __init__(
+        self,
+        broker: "Broker",
+        *,
+        interval_s: float = 1.0,
+        train_interval_s: float = 30.0,
+        seq_len: int = 64,
+        history: int = 4096,
+        batch: int = 16,
+        steps_per_round: int = 20,
+        lr: float = 1e-3,
+        model_kwargs: Optional[dict[str, Any]] = None,
+    ) -> None:
+        self.broker = broker
+        self.interval_s = interval_s
+        self.train_interval_s = train_interval_s
+        self.seq_len = seq_len
+        self.batch = batch
+        self.steps_per_round = steps_per_round
+        self.lr = lr
+        # compact model by default: 8 features need nowhere near the
+        # flagship dims, and the worker thread shares cores with the broker
+        self.model_kwargs = dict(model_kwargs or {})
+        self.model_kwargs.setdefault("d_model", 64)
+        self.model_kwargs.setdefault("n_heads", 4)
+        self.model_kwargs.setdefault("d_ff", 256)
+        self.model_kwargs.setdefault("n_layers", 2)
+        if history < seq_len + 1:
+            # the train gate needs seq_len+1 retained vectors; a smaller
+            # ring would silently never train
+            raise ValueError(
+                f"forecast history ({history}) must exceed window "
+                f"({seq_len}) — the ring must hold window+1 vectors")
+        self.ring = TelemetryRing(history)
+        self._task: Optional[asyncio.Task] = None
+        # one worker: params live on this thread, rounds never overlap
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="chanamq-forecast")
+        self._round_inflight = False
+        self._stopping = False  # cooperative cancel for an in-flight round
+        self._np_rng = np.random.default_rng(0)
+        # lazily-built JAX state (worker thread only)
+        self._jax_state: Optional[dict[str, Any]] = None
+        # latest results (event loop writes, anyone reads)
+        self.forecast: Optional[dict[str, float]] = None
+        self.loss: Optional[float] = None
+        self.trained_steps = 0
+        self.rounds = 0
+        self.updated_at: Optional[float] = None
+        self.last_error: Optional[str] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self.broker.forecaster = self
+        self._task = asyncio.get_event_loop().create_task(self._run())
+        log.info(
+            "forecast service on: interval=%.3gs train-interval=%.3gs "
+            "window=%d model=%s", self.interval_s, self.train_interval_s,
+            self.seq_len, self.model_kwargs)
+
+    async def stop(self) -> None:
+        # cooperative cancel: concurrent.futures joins worker threads at
+        # interpreter exit regardless of shutdown(wait=False), so an
+        # in-flight round must notice and bail between train steps
+        self._stopping = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        self._executor.shutdown(wait=False, cancel_futures=True)
+        if getattr(self.broker, "forecaster", None) is self:
+            self.broker.forecaster = None
+
+    # -- sampling loop (event loop; numpy only) ----------------------------
+
+    async def _run(self) -> None:
+        counters = counter_state(self.broker)
+        last = time.monotonic()
+        next_train = last + self.train_interval_s
+        while True:
+            await asyncio.sleep(self.interval_s)
+            now = time.monotonic()
+            vec, counters = sample(self.broker, counters, now - last)
+            last = now
+            self.ring.push(vec)
+            if (now >= next_train and not self._round_inflight
+                    and len(self.ring) >= self.seq_len + 1):
+                next_train = now + self.train_interval_s
+                self._round_inflight = True
+                history = self.ring.history()  # copy: worker never sees the ring
+                loop = asyncio.get_event_loop()
+                loop.run_in_executor(
+                    self._executor, self._round, history
+                ).add_done_callback(self._on_round_done)
+
+    def _on_round_done(self, fut: "asyncio.Future") -> None:
+        self._round_inflight = False
+        try:
+            result = fut.result()
+        except Exception as exc:  # noqa: BLE001 — survives a bad round
+            self.last_error = repr(exc)
+            log.exception("forecast round failed")
+            return
+        steps, loss, forecast = result
+        self.trained_steps += steps
+        if forecast is None:
+            return  # round bailed early (service stopping)
+        self.rounds += 1
+        self.loss = loss
+        self.forecast = forecast
+        self.updated_at = time.time()
+        self.last_error = None
+
+    # -- train/predict round (worker thread; owns all JAX state) -----------
+
+    def _jax_setup(self) -> dict[str, Any]:
+        import jax
+
+        from .forecaster import (
+            ForecasterConfig, forward, init_momentum, init_params,
+            make_train_step,
+        )
+
+        cfg = ForecasterConfig(seq_len=self.seq_len, **self.model_kwargs)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        state = {
+            "cfg": cfg,
+            "params": params,
+            "momentum": init_momentum(params),
+            "step": jax.jit(make_train_step(cfg, lr=self.lr)),
+            "forward": jax.jit(lambda p, x: forward(p, x, cfg)),
+        }
+        return state
+
+    def _round(
+        self, history: np.ndarray
+    ) -> tuple[int, Optional[float], Optional[dict[str, float]]]:
+        """One off-path round: K train steps + next-tick forecast."""
+        if self._jax_state is None:
+            self._jax_state = self._jax_setup()
+        state = self._jax_state
+        mean, std = normalization(history)
+        normed = (history - mean) / std
+        pairs = training_batch(normed, self.seq_len, self.batch, self._np_rng)
+        steps = 0
+        loss = None
+        if pairs is not None:
+            for _ in range(self.steps_per_round):
+                if self._stopping:
+                    return steps, loss, None
+                state["params"], state["momentum"], loss_arr = state["step"](
+                    state["params"], state["momentum"], pairs)
+                steps += 1
+            loss = float(loss_arr)
+        if self._stopping:
+            return steps, loss, None
+        window = normed[-self.seq_len:][None, ...].astype(np.float32)
+        pred = np.asarray(state["forward"](state["params"], window))[0]
+        if (loss is not None and not np.isfinite(loss)) \
+                or not np.isfinite(pred).all():
+            # diverged despite clipping: drop the poisoned params and start
+            # clean next round rather than serving NaN gauges
+            self._jax_state = None
+            raise RuntimeError(
+                f"forecaster diverged (loss={loss}); reinitializing")
+        real = pred * std + mean
+        # rates/gauges cannot be negative; the model can briefly overshoot
+        real = np.maximum(real, 0.0)
+        forecast = {name: float(v) for name, v in zip(FEATURES, real)}
+        return steps, loss, forecast
+
+    # -- introspection (admin API) -----------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        observed = self.ring.latest()
+        return {
+            "enabled": True,
+            "samples": self.ring.count,
+            "interval_s": self.interval_s,
+            "window": self.seq_len,
+            "rounds": self.rounds,
+            "trained_steps": self.trained_steps,
+            "loss": self.loss,
+            "observed": (
+                {name: float(v) for name, v in zip(FEATURES, observed)}
+                if observed is not None else None),
+            "forecast": self.forecast,
+            "updated_at": self.updated_at,
+            "error": self.last_error,
+        }

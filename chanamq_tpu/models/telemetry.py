@@ -1,0 +1,148 @@
+"""Live broker telemetry: the feature vectors the forecaster trains on.
+
+This is the wiring between chanamq_tpu.utils.metrics (counters + gauges,
+maintained on the broker's hot paths) and chanamq_tpu.models.forecaster
+(the JAX model): each sampler tick turns the counter deltas and queue
+gauges into one 8-feature vector and appends it to a fixed-size ring
+buffer. The ring is plain numpy — no JAX import, no device work — so the
+sampler can run on the broker's event loop at negligible cost; training
+and prediction read *copies* of the ring from a worker thread
+(models/service.py) and never touch broker state.
+
+The reference has no analogue (it had no metrics subsystem at all,
+SURVEY.md §5 "observability"); SURVEY.md §7.1 scopes JAX to exactly this
+role: batch analytics over broker metrics, never on the message path.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Optional
+
+import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..broker.broker import Broker
+
+# One vector per sampler tick. Rates are per-second deltas of the metrics
+# counters; depth/unacked/consumers are instantaneous gauges summed over
+# every queue in every vhost (matching models/forecaster.py:3-7).
+FEATURES: tuple[str, ...] = (
+    "publish_rate",        # messages published / s
+    "deliver_rate",        # messages delivered / s
+    "depth",               # ready messages across all queues
+    "unacked",             # outstanding (unacked) deliveries
+    "consumers",           # registered consumers
+    "publish_bytes_rate",  # body bytes published / s
+    "deliver_bytes_rate",  # body bytes delivered / s
+    "confirm_rate",        # publisher confirms / s
+)
+
+N_FEATURES = len(FEATURES)
+
+# counter names backing the rate features, in feature order
+_RATE_COUNTERS = (
+    "published_msgs", "delivered_msgs", "published_bytes",
+    "delivered_bytes", "confirmed_msgs",
+)
+_RATE_INDEX = (0, 1, 5, 6, 7)  # position of each rate in FEATURES
+
+
+def counter_state(broker: "Broker") -> dict[str, int]:
+    """Snapshot the monotonic counters a rate delta needs."""
+    metrics = broker.metrics
+    return {name: getattr(metrics, name) for name in _RATE_COUNTERS}
+
+
+def sample(
+    broker: "Broker", prev: dict[str, int], dt_s: float
+) -> tuple[np.ndarray, dict[str, int]]:
+    """One telemetry vector from the broker's live metrics.
+
+    prev is the counter snapshot from the previous tick; dt_s the elapsed
+    wall time since then. Returns (vector[N_FEATURES] float32, new snapshot).
+    """
+    current = counter_state(broker)
+    vec = np.zeros(N_FEATURES, dtype=np.float32)
+    dt = max(dt_s, 1e-6)
+    for (name, idx) in zip(_RATE_COUNTERS, _RATE_INDEX):
+        vec[idx] = (current[name] - prev.get(name, 0)) / dt
+    depth = unacked = consumers = 0
+    for vhost in broker.vhosts.values():
+        for queue in vhost.queues.values():
+            # len(), not message_count: the gauge walk must not trigger
+            # expiry work on every queue every tick
+            depth += len(queue.messages)
+            unacked += len(queue.outstanding)
+            consumers += queue.consumer_count
+    vec[2] = depth
+    vec[3] = unacked
+    vec[4] = consumers
+    return vec, current
+
+
+class TelemetryRing:
+    """Fixed-capacity ring of telemetry vectors (newest-last windows).
+
+    Single-writer (the sampler task on the event loop); readers take
+    consistent copies via window()/history() and may run on any thread.
+    """
+
+    def __init__(self, capacity: int = 4096) -> None:
+        assert capacity > 1
+        self.capacity = capacity
+        self._buf = np.zeros((capacity, N_FEATURES), dtype=np.float32)
+        self._next = 0   # write position
+        self.count = 0   # total vectors ever pushed
+
+    def push(self, vec: np.ndarray) -> None:
+        self._buf[self._next] = vec
+        self._next = (self._next + 1) % self.capacity
+        self.count += 1
+
+    def __len__(self) -> int:
+        return min(self.count, self.capacity)
+
+    def history(self) -> np.ndarray:
+        """All retained vectors, oldest first (copy)."""
+        n = len(self)
+        if self.count <= self.capacity:
+            return self._buf[:n].copy()
+        # ring has wrapped: stitch [next:] + [:next] (concatenate already
+        # allocates a fresh array)
+        return np.concatenate([self._buf[self._next:], self._buf[:self._next]])
+
+    def window(self, seq_len: int) -> Optional[np.ndarray]:
+        """The newest seq_len vectors, oldest first; None if not enough."""
+        if len(self) < seq_len:
+            return None
+        return self.history()[-seq_len:]
+
+    def latest(self) -> Optional[np.ndarray]:
+        if len(self) == 0:
+            return None
+        return self._buf[(self._next - 1) % self.capacity].copy()
+
+
+def training_batch(
+    history: np.ndarray, seq_len: int, batch: int, rng: np.random.Generator
+) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    """Sample `batch` (window, next-vector) training pairs from a history
+    array (as returned by TelemetryRing.history()). Returns (x, y) with
+    x [batch, seq_len, N_FEATURES] and y [batch, N_FEATURES], or None if
+    the history is too short for even one pair."""
+    n = len(history)
+    if n < seq_len + 1:
+        return None
+    starts = rng.integers(0, n - seq_len, size=batch)
+    x = np.stack([history[s:s + seq_len] for s in starts])
+    y = np.stack([history[s + seq_len] for s in starts])
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def normalization(history: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Per-feature (mean, std) over a history array; std floored so a
+    constant feature (e.g. consumers under steady load) never divides by
+    zero."""
+    mean = history.mean(axis=0)
+    std = np.maximum(history.std(axis=0), 1e-3)
+    return mean.astype(np.float32), std.astype(np.float32)

@@ -130,6 +130,11 @@ class AdminServer:
                 return "200 OK", self._exchanges(segments[1])
             if segments == ["cluster"]:
                 return "200 OK", self._cluster()
+            if segments == ["forecast"]:
+                forecaster = getattr(self.broker, "forecaster", None)
+                if forecaster is None:
+                    return "200 OK", {"enabled": False}
+                return "200 OK", forecaster.snapshot()
         except Exception as exc:
             return "500 Internal Server Error", {"error": str(exc)}
         return "404 Not Found", {"error": "unknown path"}
@@ -179,6 +184,18 @@ class AdminServer:
                     f"chanamq_queue_unacked{labels} {len(queue.outstanding)}")
                 out.append(
                     f"chanamq_queue_consumers{labels} {queue.consumer_count}")
+        forecaster = getattr(self.broker, "forecaster", None)
+        if forecaster is not None and forecaster.forecast is not None:
+            # next-tick telemetry forecast (models/service.py): one gauge
+            # per feature, in the telemetry ring's units
+            out.append("# TYPE chanamq_forecast gauge")
+            for name, value in forecaster.forecast.items():
+                out.append(
+                    f'chanamq_forecast{{feature="{self._prom_label(name)}"}}'
+                    f" {value}")
+            if forecaster.loss is not None:
+                out.append("# TYPE chanamq_forecast_loss gauge")
+                out.append(f"chanamq_forecast_loss {forecaster.loss}")
         return "\n".join(out) + "\n"
 
     def _overview(self) -> dict:
