@@ -58,6 +58,7 @@ class Broker:
         memory_high_watermark: int = 0,
         memory_low_watermark: Optional[int] = None,
         consumer_timeout_ms: int = 0,
+        store_max_bytes: int = 0,
     ) -> None:
         self.store = store or MemoryStore()
         self.idgen = IdGenerator(node_id)
@@ -94,7 +95,19 @@ class Broker:
         # default 30min there): a delivery unacked past this closes its
         # channel with PRECONDITION_FAILED and requeues. 0 disables.
         self.consumer_timeout_ms = consumer_timeout_ms or 0
+        # store-growth watermark (chana.mq.store.max-bytes): when page-out
+        # is absorbing a flood, RAM stays flat but the store grows without
+        # bound — this gate blocks publishers on the store's live data size
+        # (sampled each sweep tick), reopening below 80% of the cap. 0 = off.
+        self.store_max_bytes = store_max_bytes or 0
+        self.store_bytes = 0  # last sampled store size (gauge)
+        # publish bodies held at the gate across all connections (gauge;
+        # bounded by PARK_BUF_MAX per connection x max-connections)
+        self.held_bytes = 0
         self.blocked = False
+        self.blocked_reason = ""  # wire-visible cause (Connection.Blocked)
+        self._mem_over = False    # resident_bytes above the RAM watermark
+        self._store_over = False  # store size above the store watermark
         self._memory_gate = asyncio.Event()
         self._memory_gate.set()
         # callbacks fired on block/unblock transitions (connections send
@@ -149,20 +162,36 @@ class Broker:
         self.resident_bytes += delta
         if not self.memory_high_watermark:
             return
-        if not self.blocked and self.resident_bytes > self.memory_high_watermark:
-            self.blocked = True
+        if not self._mem_over and self.resident_bytes > self.memory_high_watermark:
+            self._mem_over = True
+            self._update_gate()
+        elif self._mem_over and self.resident_bytes <= self.memory_low_watermark:
+            self._mem_over = False
+            self._update_gate()
+
+    def _update_gate(self) -> None:
+        """Recompute the publisher gate from its component watermarks
+        (resident RAM, store size) and fire transitions exactly once."""
+        blocked = self._mem_over or self._store_over
+        if blocked:
+            self.blocked_reason = (
+                "memory high watermark" if self._mem_over
+                else "store size high watermark")
+        if blocked == self.blocked:
+            return
+        self.blocked = blocked
+        if blocked:
             self._memory_gate.clear()
-            self._notify_blocked(True)
-        elif self.blocked and self.resident_bytes <= self.memory_low_watermark:
-            self.blocked = False
+        else:
             self._memory_gate.set()
-            self._notify_blocked(False)
+        self._notify_blocked(blocked)
 
     def _notify_blocked(self, blocked: bool) -> None:
-        log.warning("memory %s: resident=%d high=%d low=%d",
-                    "BLOCKED" if blocked else "unblocked",
-                    self.resident_bytes, self.memory_high_watermark,
-                    self.memory_low_watermark)
+        log.warning(
+            "publishers %s: resident=%d/%d store=%d/%d",
+            "BLOCKED" if blocked else "unblocked",
+            self.resident_bytes, self.memory_high_watermark,
+            self.store_bytes, self.store_max_bytes)
         for listener in list(self.blocked_listeners):
             try:
                 listener(blocked)
@@ -195,6 +224,9 @@ class Broker:
         snap["resident_bytes"] = self.resident_bytes
         snap["memory_blocked"] = self.blocked
         snap["memory_high_watermark"] = self.memory_high_watermark
+        snap["store_bytes"] = self.store_bytes
+        snap["store_max_bytes"] = self.store_max_bytes
+        snap["held_bytes"] = self.held_bytes
         return snap
 
     # -- lifecycle ---------------------------------------------------------
@@ -206,13 +238,18 @@ class Broker:
             await self.create_vhost(DEFAULT_VHOST)
         if self.message_sweep_interval_s > 0:
             self._sweep_task = asyncio.create_task(self._sweep_loop())
-        elif self.consumer_timeout_ms:
-            # enforcement piggybacks on the sweep: without it the timeout
-            # is inert — say so instead of silently not protecting
-            log.warning(
-                "chana.mq.consumer.timeout is set but the sweep is disabled "
-                "(chana.mq.message.sweep-interval <= 0): ack timeouts will "
-                "NOT be enforced")
+        else:
+            # these all piggyback on the sweep: without it they are inert —
+            # say so instead of silently not protecting
+            for knob, active in (
+                ("chana.mq.consumer.timeout", self.consumer_timeout_ms),
+                ("chana.mq.store.max-bytes", self.store_max_bytes),
+            ):
+                if active:
+                    log.warning(
+                        "%s is set but the sweep is disabled "
+                        "(chana.mq.message.sweep-interval <= 0): it will "
+                        "NOT be enforced", knob)
         self._started = True
 
     async def stop(self) -> None:
@@ -1285,6 +1322,24 @@ class Broker:
         if ids:
             self.store_bg(self.store.delete_messages(ids))
 
+    async def _sample_store_size(self) -> None:
+        """One store-size sample for the store-growth gate: over at the
+        cap, back under at 80% of it (hysteresis like the RAM gate)."""
+        try:
+            size = await self.store.approx_data_bytes()
+        except Exception:
+            log.exception("store size sample failed")
+            return
+        if size is None:
+            return  # backend cannot report; gate inert
+        self.store_bytes = size
+        if not self._store_over and size > self.store_max_bytes:
+            self._store_over = True
+            self._update_gate()
+        elif self._store_over and size <= int(self.store_max_bytes * 0.8):
+            self._store_over = False
+            self._update_gate()
+
     # -- TTL sweep ---------------------------------------------------------
 
     async def _sweep_loop(self) -> None:
@@ -1294,6 +1349,8 @@ class Broker:
         try:
             while True:
                 await asyncio.sleep(self.message_sweep_interval_s)
+                if self.store_max_bytes:
+                    await self._sample_store_size()
                 now = now_ms()
                 expired_queues: list[Queue] = []
                 overdue_channels: set = set()

@@ -199,6 +199,8 @@ class BrokerServer:
             memory_low_watermark=low,
             consumer_timeout_ms=(
                 int(ack_timeout * 1000) if ack_timeout else 0),
+            store_max_bytes=config.size_bytes("chana.mq.store.max-bytes")
+            or 0,
         )
         return cls(
             broker=broker,
@@ -322,7 +324,20 @@ async def run_node(config) -> None:
         if config.bool("chana.mq.forecast.enabled"):
             # live-telemetry forecaster (SURVEY.md §7.1's JAX role): samples
             # metrics on the loop, trains/predicts on a worker thread,
-            # serves GET /admin/forecast + chanamq_forecast_* gauges
+            # serves GET /admin/forecast + chanamq_forecast_* gauges.
+            # Fail fast on a core-only install: without the probe, a
+            # missing jax would only surface as a traceback per train
+            # round (worker thread), never as a boot error.
+            try:
+                import jax  # noqa: F401
+                import numpy  # noqa: F401
+            except ImportError as exc:
+                from ..config import ConfigError
+
+                raise ConfigError(
+                    "chana.mq.forecast.enabled requires jax + numpy "
+                    "(pip install 'chanamq-tpu[forecast]'); "
+                    f"import failed: {exc}") from None
             from ..models.service import ForecastService
 
             forecaster = ForecastService(

@@ -87,6 +87,11 @@ DEFAULTS: dict[str, Any] = {
     # replay); FULL additionally fsyncs every group commit so confirmed
     # messages survive power loss, at a persistent-throughput cost
     "chana.mq.store.synchronous": "NORMAL",
+    # store-growth gate: when passivation/page-out absorbs a flood, RAM
+    # stays flat but the store grows — above this live-data size the
+    # publisher gate closes (like the memory watermark), reopening at 80%.
+    # None/0 disables. Sampled each sweep tick.
+    "chana.mq.store.max-bytes": None,
     # telemetry forecasting (models/service.py): sample broker metrics into
     # a ring each interval; train/predict the JAX forecaster off the event
     # loop every train-interval; serve GET /admin/forecast + Prometheus

@@ -97,6 +97,14 @@ class StoreService:
         intervals and flush() behaves as a plain barrier)."""
         return 0
 
+    async def approx_data_bytes(self) -> Optional[int]:
+        """Approximate live data size of the store, in bytes, for the
+        store-growth gate (chana.mq.store.max-bytes): when a paging flood
+        is absorbing into the store faster than consumers drain it, the
+        broker blocks publishers on this gauge the same way it does on
+        resident RAM. None = backend cannot report (gate inert)."""
+        return None
+
     # -- fire-and-forget fast paths ----------------------------------------
     # The per-message hot ops (message blob, queue-log row, unack rows) are
     # written fire-and-forget: callers need program-order enqueueing and

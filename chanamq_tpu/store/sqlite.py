@@ -409,6 +409,22 @@ class SqliteStore(StoreService):
             await loop.run_in_executor(self._executor, db.close)
         self._executor.shutdown(wait=False)
 
+    async def approx_data_bytes(self) -> Optional[int]:
+        """Live data pages × page size. page_count alone would be wrong for
+        the gate: DELETEs never shrink the file (pages go to the freelist
+        for reuse), so the gauge must subtract freelist pages or a drained
+        store would stay 'full' forever and the gate would never reopen."""
+        if self._db is None:
+            return None
+
+        def q(db: sqlite3.Connection) -> int:
+            page_size = db.execute("PRAGMA page_size").fetchone()[0]
+            page_count = db.execute("PRAGMA page_count").fetchone()[0]
+            freelist = db.execute("PRAGMA freelist_count").fetchone()[0]
+            return (page_count - freelist) * page_size
+
+        return await self._submit(q)
+
     # -- messages ---------------------------------------------------------
 
     _SQL_INSERT_MSG = "INSERT OR REPLACE INTO msgs VALUES (?,?,?,?,?,?,?)"
