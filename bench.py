@@ -459,6 +459,103 @@ def run_cluster_spec() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+async def _replicate_spec() -> dict:
+    """Two in-process nodes with PRIVATE MemoryStores, replicate.factor=2 +
+    sync=true: persistent confirmed publishes to the owner, so every confirm
+    gates on the follower's replication ack. Measures the price of the
+    synchronous durability upgrade (confirm latency) plus the shipping
+    pipeline's health (event lag, per-batch ack latency)."""
+    from chanamq_tpu.amqp.properties import BasicProperties
+    from chanamq_tpu.broker.server import BrokerServer
+    from chanamq_tpu.client import AMQPClient
+    from chanamq_tpu.cluster.node import ClusterNode
+    from chanamq_tpu.store.memory import MemoryStore
+
+    persistent = BasicProperties(delivery_mode=2)
+
+    async def start_node(seeds):
+        srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                           store=MemoryStore())
+        await srv.start()
+        cl = ClusterNode(srv.broker, "127.0.0.1", 0, seeds,
+                         heartbeat_interval_s=0.2, failure_timeout_s=5,
+                         replicate_factor=2, replicate_sync=True,
+                         replicate_ack_timeout_ms=2000)
+        await cl.start()
+        return srv, cl
+
+    a_srv = a_cl = b_srv = b_cl = None
+    try:
+        a_srv, a_cl = await start_node([])
+        b_srv, b_cl = await start_node([a_cl.name])
+        for _ in range(100):
+            if (len(a_cl.membership.alive_members()) == 2
+                    and len(b_cl.membership.alive_members()) == 2):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise RuntimeError("2-node membership did not converge")
+        # a queue OWNED by node A: publishes ride the local fast path and
+        # the confirm barrier's replication gate, not a remote push
+        qn = next(f"rq{i}" for i in range(200)
+                  if a_cl.queue_owner("/", f"rq{i}") == a_cl.name)
+        body = b"x" * BODY_BYTES
+        c = await AMQPClient.connect("127.0.0.1", a_srv.bound_port)
+        ch = await c.channel()
+        await ch.confirm_select()
+        await ch.queue_declare(qn, durable=True)
+
+        # confirm latency: solo publishes, each awaiting its own confirm
+        lat_us = []
+        for _ in range(200):
+            t0 = time.perf_counter()
+            ch.basic_publish(body, routing_key=qn, properties=persistent)
+            await ch.wait_unconfirmed_below(1, timeout=10)
+            lat_us.append((time.perf_counter() - t0) * 1e6)
+        lat_us.sort()
+
+        # throughput: one pipelined confirmed burst
+        n = 2000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ch.basic_publish(body, routing_key=qn, properties=persistent)
+        await ch.wait_unconfirmed_below(1, timeout=60)
+        rate = n / (time.perf_counter() - t0)
+        await c.close()
+
+        repl = a_cl.replication
+        snap = a_srv.broker.metrics.snapshot()
+        follower_applied = sum(
+            copy.applied_seq for copy in b_cl.replication.applier.copies.values())
+        return {
+            "sync_confirm_p50_us": round(lat_us[len(lat_us) // 2], 1),
+            "sync_confirm_p99_us": round(lat_us[int(len(lat_us) * 0.99)], 1),
+            "sync_publish_msgs_per_s": round(rate, 1),
+            "repl_lag_events": repl.total_lag(),
+            "repl_ack_p50_us": snap.get("repl_ack_p50_us"),
+            "repl_ack_p99_us": snap.get("repl_ack_p99_us"),
+            "events_shipped": snap.get("repl_events_shipped"),
+            "batches_shipped": snap.get("repl_batches_shipped"),
+            "ack_timeouts": snap.get("repl_ack_timeouts"),
+            "follower_applied_seq": follower_applied,
+            "messages": n + len(lat_us),
+        }
+    finally:
+        for part in (b_cl, b_srv, a_cl, a_srv):
+            if part is not None:
+                try:
+                    await part.stop()
+                except Exception:
+                    pass
+
+
+def run_replicate_spec() -> dict:
+    try:
+        return asyncio.run(asyncio.wait_for(_replicate_spec(), timeout=120))
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def main() -> None:
     if "--role" in sys.argv:
         import argparse
@@ -481,6 +578,26 @@ def main() -> None:
         else:
             asyncio.run(consumer_main(
                 args.port, bool(args.auto_ack), args.seconds, args.queue))
+        return
+
+    if "--replicate" in sys.argv:
+        # replication scenario only: factor-2 sync confirms on private
+        # per-node stores (lag + confirm latency as its own BENCH line)
+        result = run_replicate_spec()
+        print(f"# replicate_2node: {result}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "replicated_sync_confirm_p99_us",
+            "value": result.get("sync_confirm_p99_us"),
+            "unit": "us",
+            "vs_baseline": None,
+            "repl_lag_events": result.get("repl_lag_events"),
+            "sync_publish_msgs_per_s":
+                result.get("sync_publish_msgs_per_s"),
+            "body_bytes": BODY_BYTES,
+            "replicate_2node": result,
+            **({"error": {"replicate_2node": result["error"]}}
+               if "error" in result else {}),
+        }))
         return
 
     which = os.environ.get("BENCH_SPECS", "all")

@@ -183,6 +183,7 @@ class Broker:
         if blocked:
             self._memory_gate.clear()
         else:
+            self.blocked_reason = ""
             self._memory_gate.set()
         self._notify_blocked(blocked)
 
@@ -227,6 +228,8 @@ class Broker:
         snap["store_bytes"] = self.store_bytes
         snap["store_max_bytes"] = self.store_max_bytes
         snap["held_bytes"] = self.held_bytes
+        if self.cluster is not None and self.cluster.replication is not None:
+            snap["repl_lag_events"] = self.cluster.replication.total_lag()
         return snap
 
     # -- lifecycle ---------------------------------------------------------
@@ -438,6 +441,14 @@ class Broker:
         queue = vhost.queues.get(name)
         if queue is not None:
             return queue
+        if self.cluster is not None and self.cluster.replication is not None:
+            # a failover promotion may be materializing this queue from a
+            # warm replica right now — racing it with the cold path below
+            # would claim an empty shell over the promoted copy
+            await self.cluster.replication.await_promotion(vhost_name, name)
+            queue = vhost.queues.get(name)
+            if queue is not None:
+                return queue
         stored = await self.store.select_queue(vhost_name, name)
         if stored is not None:
             queue = await self._load_stored_queue(stored)
@@ -637,6 +648,8 @@ class Broker:
             ))
         if self.cluster is not None and exclusive_owner is None:
             self.cluster._register_meta(queue)
+            if self.cluster.replication is not None:
+                self.cluster.replication.attach(queue)
             self.cluster.broadcast_bg("meta.apply", {
                 "kind": "queue.declared", "vhost": vhost_name, "name": name,
                 "durable": durable, "auto_delete": auto_delete,
@@ -900,6 +913,10 @@ class Broker:
             await self.store.delete_queue(vhost.name, queue.name)
             await self.store.delete_queue_binds(vhost.name, queue.name)
         if self.cluster is not None and queue.exclusive_owner is None:
+            if self.cluster.replication is not None:
+                # final "delete" event tears down follower copies
+                self.cluster.replication.detach(
+                    vhost.name, queue.name, deleted=True)
             # the reference's QueueDeleted pub-sub broadcast
             self.cluster.queue_metas.pop((vhost.name, queue.name), None)
             self.cluster.broadcast_bg("meta.apply", {

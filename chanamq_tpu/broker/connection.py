@@ -413,6 +413,10 @@ class AMQPConnection:
                         for rest in later:
                             self.broker.held_bytes -= self._held_cost(rest)
                     return False
+        # same barrier as the main loop: confirms for persistent publishes
+        # must not ack until their store writes are flushed (a barrier
+        # failure propagates and tears the connection down, like there)
+        await self._confirm_barrier()
         self._flush_confirms()
         return True
 
@@ -719,6 +723,13 @@ class AMQPConnection:
         if self._pending_confirms:
             intervals, self._confirm_marks = self._confirm_marks, []
             await self.broker.store.flush(intervals)
+            cluster = self.broker.cluster
+            if (cluster is not None and cluster.replication is not None
+                    and cluster.replication.sync):
+                # chana.mq.replicate.sync: confirms additionally gate on
+                # follower acks, so a confirmed persistent message survives
+                # the loss of this whole node (bounded by ack-timeout)
+                await cluster.replication.sync_barrier()
 
     async def _settle_remote_failures(self) -> None:
         """Drain pipelined remote pushes and account for their failures:
@@ -1547,6 +1558,9 @@ class AMQPConnection:
                 self.broker.store.insert_queue_unacks_nowait(
                     queue.vhost, queue.name,
                     [(msg.id, qm.offset, qm.body_size, qm.expire_at_ms)])
+                if queue.repl is not None:
+                    queue.repl.append("unacks", {"rows": [
+                        [msg.id, qm.offset, qm.body_size, qm.expire_at_ms]]})
 
     async def _on_get_remote(self, channel: ServerChannel, method: am.Basic.Get) -> None:
         """basic.get on a remotely-owned queue: fetch one message over RPC

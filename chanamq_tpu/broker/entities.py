@@ -217,6 +217,11 @@ class Queue:
         self.last_used = now_ms()
         # body bytes across READY messages (limit enforcement + gauge)
         self.ready_bytes = 0
+        # replication log when this node owns a replicated queue (bound by
+        # ReplicationManager.attach); every durable store mutation below
+        # mirrors itself into it so followers track exactly the rows a
+        # restart of THIS node would recover
+        self.repl = None  # Optional[replicate.QueueRepLog]
 
         self.messages: deque[QueuedMessage] = deque()
         self.next_offset = 1
@@ -299,6 +304,11 @@ class Queue:
                 self.vhost, self.name, qm.offset, message.id,
                 qm.body_size, qm.expire_at_ms,
             )
+            if self.repl is not None:
+                # before this call's own passivation below, so the body is
+                # normally still resident; a fanout sibling may already have
+                # paged it (body None) — the follower then resyncs the blob
+                self.repl.enqueue(qm, message)
         # length/byte caps: drop-head overflow, dead-lettering each victim
         # (x-overflow=drop-head is the only supported policy; declare
         # rejects others). Runs before passivation so a dropped entry is
@@ -376,6 +386,14 @@ class Queue:
                     self.broker.store.insert_queue_msg(
                         self.vhost, self.name, qm.offset, qm.message.id,
                         qm.body_size, qm.expire_at_ms))
+            if self.repl is not None:
+                # row_add strictly before unack_del: the unack entry holds
+                # the follower's last blob reference until the row re-lands
+                if not row_present:
+                    self.repl.append("row_add", {
+                        "o": qm.offset, "m": qm.message.id,
+                        "z": qm.body_size, "e": qm.expire_at_ms})
+                self.repl.append("unack_del", {"ids": [qm.message.id]})
 
     def _insert_by_priority(self, qm: QueuedMessage) -> None:
         """Ready-set ordering for priority queues: (priority desc, offset).
@@ -477,6 +495,8 @@ class Queue:
             self.broker.store_bg(
                 self.broker.store.delete_queue_msgs_offsets(
                     self.vhost, self.name, offsets))
+            if self.repl is not None:
+                self.repl.append("row_del", {"offs": offsets})
 
     def _persist_watermark(self) -> None:
         self._wm_dirty = False
@@ -487,6 +507,8 @@ class Queue:
                 self.vhost, self.name, self.last_consumed
             )
         )
+        if self.repl is not None:
+            self.repl.append("watermark", {"wm": self.last_consumed})
 
     def flush_store_buffers(self) -> None:
         """Flush per-tick coalescing buffers now (shutdown path)."""
@@ -550,6 +572,9 @@ class Queue:
         if new_unacks:
             self.broker.store.insert_queue_unacks_nowait(
                 self.vhost, self.name, new_unacks)
+            if self.repl is not None:
+                self.repl.append(
+                    "unacks", {"rows": [list(r) for r in new_unacks]})
 
     # -- passivation / hydration -------------------------------------------
 
@@ -768,6 +793,8 @@ class Queue:
             self.broker.store_bg(
                 self.broker.store.delete_queue_unacks(self.vhost, self.name, ids)
             )
+            if self.repl is not None:
+                self.repl.append("unack_del", {"ids": ids})
 
     def drop(self, delivery: Delivery) -> None:
         """Reject without requeue: same store cleanup as ack, then the
@@ -788,6 +815,8 @@ class Queue:
                         self.vhost, self.name, [qm.message.id]
                     )
                 )
+                if self.repl is not None:
+                    self.repl.append("unack_del", {"ids": [qm.message.id]})
             self._settle_dead(qm, "expired")
             return
         self.ready_bytes += qm.body_size
@@ -836,6 +865,15 @@ class Queue:
                         self.vhost, self.name, self.last_consumed
                     )
                 )
+                if self.repl is not None:
+                    # row back first (keeps the blob referenced), then the
+                    # unack settle, then the rewound watermark
+                    self.repl.append("row_add", {
+                        "o": qm.offset, "m": qm.message.id,
+                        "z": qm.body_size, "e": qm.expire_at_ms})
+                    self.repl.append("unack_del", {"ids": [qm.message.id]})
+                    self.repl.append(
+                        "watermark", {"wm": self.last_consumed})
         self.schedule_dispatch()
 
     # -- purge / consumers -------------------------------------------------
@@ -856,6 +894,8 @@ class Queue:
             self.broker.store_bg(
                 self.broker.store.purge_queue_msgs(self.vhost, self.name)
             )
+            if self.repl is not None:
+                self.repl.append("purge", {})
         return count
 
     def add_consumer(self, consumer: "Consumer") -> None:

@@ -314,6 +314,12 @@ async def run_node(config) -> None:
                     "chana.mq.cluster.heartbeat-interval") or 1.0,
                 failure_timeout_s=config.duration_s(
                     "chana.mq.cluster.failure-timeout") or 5.0,
+                replicate_factor=config.int("chana.mq.replicate.factor"),
+                replicate_sync=config.bool("chana.mq.replicate.sync"),
+                replicate_batch_max=config.int(
+                    "chana.mq.replicate.batch-max"),
+                replicate_ack_timeout_ms=config.int(
+                    "chana.mq.replicate.ack-timeout-ms"),
             )
             await cluster.start()
         if stop_event.is_set():

@@ -66,3 +66,25 @@ class HashRing:
     def owner_entity(self, kind: str, vhost: str, name: str) -> Optional[str]:
         # '\x00' can't appear in AMQP short strings, so the key is unambiguous
         return self.owner(f"{kind}\x00{vhost}\x00{name}")
+
+    def preference(self, key: str, count: int) -> list[str]:
+        """The first `count` DISTINCT nodes clockwise from the key's point
+        (Dynamo-style preference list): [owner, 1st successor, ...]. Used by
+        replication to pick follower nodes — successors keep the replica
+        placement stable under membership churn (only ~1/N of keys move)."""
+        if not self._ring or count <= 0:
+            return []
+        start = bisect.bisect_right(self._points, _hash(key)) % len(self._ring)
+        out: list[str] = []
+        for i in range(len(self._ring)):
+            node = self._ring[(start + i) % len(self._ring)][1]
+            if node not in out:
+                out.append(node)
+                if len(out) >= count:
+                    break
+        return out
+
+    def preference_entity(
+        self, kind: str, vhost: str, name: str, count: int
+    ) -> list[str]:
+        return self.preference(f"{kind}\x00{vhost}\x00{name}", count)

@@ -32,6 +32,7 @@ import logging
 from typing import TYPE_CHECKING, Any, Optional
 
 from ..amqp.properties import BasicProperties
+from ..replicate import ReplicationManager
 from .hashring import HashRing
 from .membership import Member, Membership
 from .rpc import RpcError, RpcServer
@@ -59,6 +60,10 @@ class ClusterNode:
         virtual_nodes: int = 64,
         heartbeat_interval_s: float = 1.0,
         failure_timeout_s: float = 5.0,
+        replicate_factor: int = 1,
+        replicate_sync: bool = False,
+        replicate_batch_max: int = 256,
+        replicate_ack_timeout_ms: int = 1000,
     ) -> None:
         self.broker = broker
         self.rpc = RpcServer(host, port)
@@ -79,6 +84,14 @@ class ClusterNode:
         self.name: str = ""
         broker.cluster = self
         self._register_handlers()
+        # queue replication (chana.mq.replicate.*): factor 1 = off; the
+        # manager registers its own repl.* RPC handlers
+        self.replication: Optional[ReplicationManager] = (
+            ReplicationManager(
+                self, factor=replicate_factor, sync=replicate_sync,
+                batch_max=replicate_batch_max,
+                ack_timeout_ms=replicate_ack_timeout_ms)
+            if replicate_factor > 1 else None)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -231,6 +244,11 @@ class ClusterNode:
                     # idle shell owned elsewhere by the ring: hand off
                     queue.deleted = True
                     del vhost.queues[name]
+                    if self.replication is not None:
+                        # close (not delete) the outgoing log: the next
+                        # owner opens its own from seq 0 and followers
+                        # resync against it on the owner-change
+                        self.replication.detach(vhost.name, name)
                     if other is not None:
                         self._set_holder(vhost.name, name, None)
                     continue
@@ -270,6 +288,8 @@ class ClusterNode:
             return
         self._register_meta(queue)
         self._set_holder(queue.vhost, queue.name, self.name)
+        if self.replication is not None:
+            self.replication.attach(queue)
 
     # ------------------------------------------------------------------
     # membership reactions
@@ -285,6 +305,14 @@ class ClusterNode:
             for meta in self.queue_metas.values():
                 if meta.get("holder") == member.name:
                     meta["holder"] = None
+        if self.replication is not None:
+            # BEFORE the reconcile task below is created: promotion intents
+            # must be registered so activate_queue can await them instead of
+            # cold-activating an empty shell over a warm replica
+            if event == "down":
+                self.replication.on_node_down(member.name)
+            else:
+                self.replication.on_membership()
         self._deactivate_unowned()
         # re-register remote consumers whose queues changed owner; also
         # requeue outstanding deliveries from consumers whose origin died
@@ -651,6 +679,8 @@ class ClusterNode:
                 # group commit covering the blob + queue-log rows above
                 # (attributed to just this push's enqueue window)
                 await self.broker.store.flush(marks)
+                if self.replication is not None and self.replication.sync:
+                    await self.replication.sync_barrier()
         return {"pushed": bool(queues), "had_consumer": had_consumer}
 
     async def _h_queue_push_many(self, payload: dict) -> dict:
@@ -676,6 +706,8 @@ class ClusterNode:
             any_persisted = any_persisted or message.persisted
         if any_persisted:
             await self.broker.store.flush(marks)
+            if self.replication is not None and self.replication.sync:
+                await self.replication.sync_barrier()
         return {"ok": True}
 
     async def _h_queue_get(self, payload: dict) -> dict:
@@ -707,6 +739,9 @@ class ClusterNode:
                 self.broker.store_bg(self.broker.store.insert_queue_unacks(
                     queue.vhost, queue.name,
                     [(msg.id, qm.offset, qm.body_size, qm.expire_at_ms)]))
+                if queue.repl is not None:
+                    queue.repl.append("unacks", {"rows": [
+                        [msg.id, qm.offset, qm.body_size, qm.expire_at_ms]]})
         return out
 
     async def _h_queue_consume(self, payload: dict) -> dict:

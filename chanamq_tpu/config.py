@@ -108,6 +108,16 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.cluster.heartbeat-interval": "1s",
     "chana.mq.cluster.failure-timeout": "5s",
     "chana.mq.cluster.virtual-nodes": 64,
+    # queue replication (replicate/): each queue's mutations are log-shipped
+    # to factor-1 follower nodes which keep a warm passive copy; on owner
+    # death the highest-synced follower promotes. factor=1 disables.
+    "chana.mq.replicate.factor": 1,
+    # sync=true gates publisher confirms on follower acks (no confirmed
+    # persistent message can be lost to a single node failure); sync=false
+    # ships asynchronously (bounded loss window = replication lag).
+    "chana.mq.replicate.sync": False,
+    "chana.mq.replicate.batch-max": 256,   # events per shipped batch
+    "chana.mq.replicate.ack-timeout-ms": 1000,
 }
 
 _DURATION_RE = re.compile(r"^\s*([0-9.]+)\s*(ms|s|m|h|d)?\s*$")

@@ -102,6 +102,7 @@ class ForecastService:
     async def start(self) -> None:
         self.broker.forecaster = self
         self._task = asyncio.get_event_loop().create_task(self._run())
+        self._task.add_done_callback(self._on_run_done)
         log.info(
             "forecast service on: interval=%.3gs train-interval=%.3gs "
             "window=%d model=%s", self.interval_s, self.train_interval_s,
@@ -131,19 +132,34 @@ class ForecastService:
         next_train = last + self.train_interval_s
         while True:
             await asyncio.sleep(self.interval_s)
-            now = time.monotonic()
-            vec, counters = sample(self.broker, counters, now - last)
-            last = now
-            self.ring.push(vec)
-            if (now >= next_train and not self._round_inflight
-                    and len(self.ring) >= self.seq_len + 1):
-                next_train = now + self.train_interval_s
-                self._round_inflight = True
-                history = self.ring.history()  # copy: worker never sees the ring
-                loop = asyncio.get_event_loop()
-                loop.run_in_executor(
-                    self._executor, self._round, history
-                ).add_done_callback(self._on_round_done)
+            try:
+                now = time.monotonic()
+                vec, counters = sample(self.broker, counters, now - last)
+                last = now
+                self.ring.push(vec)
+                if (now >= next_train and not self._round_inflight
+                        and len(self.ring) >= self.seq_len + 1):
+                    next_train = now + self.train_interval_s
+                    self._round_inflight = True
+                    history = self.ring.history()  # copy: worker never sees the ring
+                    loop = asyncio.get_event_loop()
+                    loop.run_in_executor(
+                        self._executor, self._round, history
+                    ).add_done_callback(self._on_round_done)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — a bad sample tick
+                # must not kill forecasting forever; record and keep sampling
+                self.last_error = repr(exc)
+                log.exception("forecast sample tick failed")
+
+    def _on_run_done(self, task: "asyncio.Task") -> None:
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            self.last_error = repr(exc)
+            log.error("forecast sampler task died", exc_info=exc)
 
     def _on_round_done(self, fut: "asyncio.Future") -> None:
         self._round_inflight = False
@@ -203,7 +219,8 @@ class ForecastService:
                 state["params"], state["momentum"], loss_arr = state["step"](
                     state["params"], state["momentum"], pairs)
                 steps += 1
-            loss = float(loss_arr)
+            if steps:  # steps_per_round == 0 leaves loss_arr unbound
+                loss = float(loss_arr)
         if self._stopping:
             return steps, loss, None
         window = normed[-self.seq_len:][None, ...].astype(np.float32)

@@ -130,6 +130,8 @@ class AdminServer:
                 return "200 OK", self._exchanges(segments[1])
             if segments == ["cluster"]:
                 return "200 OK", self._cluster()
+            if segments == ["replication"]:
+                return "200 OK", self._replication()
             if segments == ["forecast"]:
                 forecaster = getattr(self.broker, "forecaster", None)
                 if forecaster is None:
@@ -147,6 +149,9 @@ class AdminServer:
         "delivered_bytes", "returned_msgs", "confirmed_msgs",
         "expired_msgs", "dead_lettered_msgs", "connections_opened",
         "connections_closed", "connections_refused",
+        "repl_events_shipped", "repl_batches_shipped",
+        "repl_events_applied", "repl_resyncs", "repl_promotions",
+        "repl_ack_timeouts",
     })
 
     @staticmethod
@@ -256,7 +261,23 @@ class AdminServer:
             "alive": cluster.membership.alive_members(),
             "known_queues": len(cluster.queue_metas),
             "owned_queues": owned,
+            "replication": (
+                {"enabled": False} if cluster.replication is None else {
+                    "enabled": True,
+                    "factor": cluster.replication.factor,
+                    "sync": cluster.replication.sync,
+                    "lag_events": cluster.replication.total_lag(),
+                    "copies": len(cluster.replication.applier.copies),
+                }),
         }
+
+    def _replication(self) -> dict:
+        """Per-queue replica state: role, follower ack positions, and event
+        lag on owned queues; applied position on follower copies."""
+        cluster = self.broker.cluster
+        if cluster is None or cluster.replication is None:
+            return {"enabled": False}
+        return cluster.replication.status()
 
     def _exchanges(self, vhost_name: str) -> list:
         vhost = self.broker.vhosts.get(vhost_name)

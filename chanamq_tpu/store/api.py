@@ -26,6 +26,29 @@ def _done_future() -> "asyncio.Future[None]":
     return fut
 
 
+# -- replica namespaces (replicate/) ----------------------------------------
+# A follower keeps its warm passive copy of a replicated queue under a
+# namespaced vhost so the copy shares the blob table / group-commit engine
+# with real data but can never collide with it: '\x00' is illegal in AMQP
+# short strings, so no client-declared vhost can start with the marker.
+# all_queues() excludes replica namespaces — recovery must not resurrect
+# passive copies as live queues.
+
+REPLICA_NS = "\x00repl\x00"
+
+
+def replica_vhost(vhost: str) -> str:
+    return REPLICA_NS + vhost
+
+
+def is_replica_vhost(vhost: str) -> bool:
+    return vhost.startswith(REPLICA_NS)
+
+
+def real_vhost(vhost: str) -> str:
+    return vhost[len(REPLICA_NS):] if is_replica_vhost(vhost) else vhost
+
+
 
 
 @dataclass(slots=True)
@@ -211,6 +234,8 @@ class StoreService:
         raise NotImplementedError
 
     async def all_queues(self, vhost: Optional[str] = None) -> list[StoredQueue]:
+        """Every stored queue, EXCLUDING replica namespaces (passive copies
+        must never recover as live queues)."""
         raise NotImplementedError
 
     # -- queue message log (reference: insertQueueMsg/deleteQueueMsg) ------
@@ -223,6 +248,46 @@ class StoreService:
 
     async def delete_queue_msg(self, vhost: str, queue: str, offset: int) -> None:
         raise NotImplementedError
+
+    async def iter_queue_msgs(
+        self, vhost: str, queue: str, after_offset: int, limit: int
+    ) -> list[tuple[int, int, int, Optional[int]]]:
+        """Page through a queue's pending log rows in offset order:
+        up to `limit` rows with offset > after_offset, as
+        (offset, msg_id, body_size, expire_at_ms). Replication resync uses
+        this to stream the owner's snapshot in bounded chunks. The default
+        rides select_queue; SqliteStore overrides with a ranged query."""
+        sq = await self.select_queue(vhost, queue)
+        if sq is None:
+            return []
+        rows = sorted(m for m in sq.msgs if m[0] > after_offset)
+        return rows[:limit]
+
+    async def replace_queue_msgs(
+        self, vhost: str, queue: str,
+        msgs: list[tuple[int, int, int, Optional[int]]],
+    ) -> None:
+        """Swap a queue's pending log rows wholesale (replication resync
+        installs the owner's snapshot; promotion materializes a passive
+        copy). msgs: (offset, msg_id, body_size, expire_at_ms)."""
+        await self.purge_queue_msgs(vhost, queue)
+        for offset, msg_id, body_size, expire_at_ms in msgs:
+            await self.insert_queue_msg(
+                vhost, queue, offset, msg_id, body_size, expire_at_ms)
+
+    async def replace_queue_unacks(
+        self, vhost: str, queue: str,
+        unacks: list[tuple[int, int, int, Optional[int]]],
+    ) -> None:
+        """Swap a queue's unack rows wholesale (companion of
+        replace_queue_msgs). unacks: (msg_id, offset, body_size,
+        expire_at_ms)."""
+        existing = await self.select_queue(vhost, queue)
+        if existing and existing.unacks:
+            await self.delete_queue_unacks(
+                vhost, queue, list(existing.unacks))
+        if unacks:
+            await self.insert_queue_unacks(vhost, queue, unacks)
 
     # -- consumption watermark + unacks (reference: updateQueueLastConsumed,
     #    insertQueueUnack/deleteQueueUnack) --------------------------------

@@ -19,7 +19,10 @@ from __future__ import annotations
 import copy
 from typing import Optional
 
-from .api import StoredExchange, StoredMessage, StoredQueue, StoreService
+from .api import (
+    StoredExchange, StoredMessage, StoredQueue, StoreService,
+    is_replica_vhost,
+)
 
 
 class _Done:
@@ -108,7 +111,7 @@ class MemoryStore(StoreService):
         return [
             copy.deepcopy(q)
             for (vh, _), q in self.queues.items()
-            if vhost is None or vh == vhost
+            if not is_replica_vhost(vh) and (vhost is None or vh == vhost)
         ]
 
     # -- queue log --------------------------------------------------------
@@ -153,6 +156,21 @@ class MemoryStore(StoreService):
         if q:
             for msg_id in msg_ids:
                 q.unacks.pop(msg_id, None)
+        return _DONE
+
+    def replace_queue_msgs(self, vhost, queue, msgs):
+        q = self.queues.get((vhost, queue))
+        if q:
+            q.msgs = [tuple(m) for m in msgs]
+        return _DONE
+
+    def replace_queue_unacks(self, vhost, queue, unacks):
+        q = self.queues.get((vhost, queue))
+        if q:
+            q.unacks = {
+                msg_id: (offset, body_size, expire_at_ms)
+                for msg_id, offset, body_size, expire_at_ms in unacks
+            }
         return _DONE
 
     # -- fire-and-forget fast paths: writes already apply at call time, so

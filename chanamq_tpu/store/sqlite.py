@@ -36,7 +36,10 @@ import sqlite3
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional, TypeVar
 
-from .api import StoredExchange, StoredMessage, StoredQueue, StoreService
+from .api import (
+    StoredExchange, StoredMessage, StoredQueue, StoreService,
+    is_replica_vhost,
+)
 
 log = logging.getLogger("chanamq.store")
 
@@ -543,6 +546,8 @@ class SqliteStore(StoreService):
         names = await self._submit(q)
         out = []
         for vh, name in names:
+            if is_replica_vhost(vh):
+                continue  # passive replica copies never recover as live
             sq = await self.select_queue(vh, name)
             if sq:
                 out.append(sq)
@@ -568,6 +573,35 @@ class SqliteStore(StoreService):
         return self._submit(lambda db: db.execute(
             "DELETE FROM queue_msgs WHERE vhost=? AND queue=? AND offset=?",
             (vhost, queue, offset)), guard=False)
+
+    async def iter_queue_msgs(self, vhost, queue, after_offset, limit):
+        rows = await self._submit(lambda db: db.execute(
+            "SELECT offset, msg_id, body_size, expire_at_ms FROM queue_msgs "
+            "WHERE vhost=? AND queue=? AND offset>? ORDER BY offset LIMIT ?",
+            (vhost, queue, after_offset, limit)).fetchall())
+        return [tuple(r) for r in rows]
+
+    def replace_queue_msgs(self, vhost, queue, msgs):
+        def w(db: sqlite3.Connection):
+            db.execute(
+                "DELETE FROM queue_msgs WHERE vhost=? AND queue=?",
+                (vhost, queue))
+            db.executemany(
+                self._SQL_INSERT_QUEUE_MSG,
+                [(vhost, queue, o, m, s, e) for (o, m, s, e) in msgs])
+
+        return self._submit(w)
+
+    def replace_queue_unacks(self, vhost, queue, unacks):
+        def w(db: sqlite3.Connection):
+            db.execute(
+                "DELETE FROM queue_unacks WHERE vhost=? AND queue=?",
+                (vhost, queue))
+            db.executemany(
+                "INSERT OR REPLACE INTO queue_unacks VALUES (?,?,?,?,?,?)",
+                [(vhost, queue, m, o, s, e) for (m, o, s, e) in unacks])
+
+        return self._submit(w)
 
     # -- watermark + unacks ------------------------------------------------
 

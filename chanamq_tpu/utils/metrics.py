@@ -68,6 +68,15 @@ class Metrics:
         # accepts refused at the listener cap (chana.mq.server.max-connections)
         self.connections_refused = 0
         self.publish_to_deliver_us = Histogram()
+        # queue replication (replicate/): owner-side ship + follower-side
+        # apply counters and the owner-observed follower ack latency
+        self.repl_events_shipped = 0
+        self.repl_batches_shipped = 0
+        self.repl_events_applied = 0
+        self.repl_resyncs = 0
+        self.repl_promotions = 0
+        self.repl_ack_timeouts = 0
+        self.repl_ack_us = Histogram()
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -97,4 +106,13 @@ class Metrics:
             "publish_to_deliver_p50_us": h.percentile_us(0.50),
             "publish_to_deliver_p99_us": h.percentile_us(0.99),
             "publish_to_deliver_mean_us": h.mean_us,
+            "repl_events_shipped": self.repl_events_shipped,
+            "repl_batches_shipped": self.repl_batches_shipped,
+            "repl_events_applied": self.repl_events_applied,
+            "repl_resyncs": self.repl_resyncs,
+            "repl_promotions": self.repl_promotions,
+            "repl_ack_timeouts": self.repl_ack_timeouts,
+            "repl_ack_p50_us": self.repl_ack_us.percentile_us(0.50),
+            "repl_ack_p99_us": self.repl_ack_us.percentile_us(0.99),
+            "repl_ack_mean_us": self.repl_ack_us.mean_us,
         }
