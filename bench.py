@@ -363,27 +363,36 @@ def run_spec(name: str, rate: int = 0) -> dict:
     }
 
 
+async def _start_cluster_node(seeds, store_factory, **cluster_kwargs):
+    """Shared bootstrap for the in-process 2-node specs: a BrokerServer on
+    an ephemeral port wrapped in a ClusterNode joined to `seeds`. The store
+    backend and replication knobs are the only things the specs vary."""
+    from chanamq_tpu.broker.server import BrokerServer
+    from chanamq_tpu.cluster.node import ClusterNode
+
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                       store=store_factory())
+    await srv.start()
+    cl = ClusterNode(srv.broker, "127.0.0.1", 0, seeds,
+                     heartbeat_interval_s=0.2, failure_timeout_s=5,
+                     **cluster_kwargs)
+    await cl.start()
+    return srv, cl
+
+
 async def _cluster_spec() -> dict:
     """Two in-process nodes sharing a store: publish a burst via the
     NON-owner (batch-pipelined queue.push_many), then consume remotely
     (per-tick deliver_many events). Evidence for the cluster fast paths;
     in-process, so both nodes share this one core."""
-    from chanamq_tpu.broker.server import BrokerServer
     from chanamq_tpu.client import AMQPClient
-    from chanamq_tpu.cluster.node import ClusterNode
     from chanamq_tpu.store.sqlite import SqliteStore
 
     tmpdir = tempfile.mkdtemp(prefix="bench-cluster-")
     store = os.path.join(tmpdir, "shared.db")
 
-    async def start_node(seeds):
-        srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
-                           store=SqliteStore(store))
-        await srv.start()
-        cl = ClusterNode(srv.broker, "127.0.0.1", 0, seeds,
-                         heartbeat_interval_s=0.2, failure_timeout_s=5)
-        await cl.start()
-        return srv, cl
+    def start_node(seeds):
+        return _start_cluster_node(seeds, lambda: SqliteStore(store))
 
     a_srv = a_cl = b_srv = b_cl = None
     try:
@@ -466,23 +475,15 @@ async def _replicate_spec() -> dict:
     synchronous durability upgrade (confirm latency) plus the shipping
     pipeline's health (event lag, per-batch ack latency)."""
     from chanamq_tpu.amqp.properties import BasicProperties
-    from chanamq_tpu.broker.server import BrokerServer
     from chanamq_tpu.client import AMQPClient
-    from chanamq_tpu.cluster.node import ClusterNode
     from chanamq_tpu.store.memory import MemoryStore
 
     persistent = BasicProperties(delivery_mode=2)
 
-    async def start_node(seeds):
-        srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
-                           store=MemoryStore())
-        await srv.start()
-        cl = ClusterNode(srv.broker, "127.0.0.1", 0, seeds,
-                         heartbeat_interval_s=0.2, failure_timeout_s=5,
-                         replicate_factor=2, replicate_sync=True,
-                         replicate_ack_timeout_ms=2000)
-        await cl.start()
-        return srv, cl
+    def start_node(seeds):
+        return _start_cluster_node(
+            seeds, MemoryStore, replicate_factor=2, replicate_sync=True,
+            replicate_ack_timeout_ms=2000)
 
     a_srv = a_cl = b_srv = b_cl = None
     try:
@@ -556,6 +557,114 @@ def run_replicate_spec() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+async def _stream_spec() -> dict:
+    """Stream-queue scenario: ONE confirmed producer appends to an
+    x-queue-type=stream queue while THREE independent cursors read it —
+    attached at "first" (replays the pre-run backlog then follows),
+    "next" (tail only) and a mid-run timestamp — every cursor manual-ack
+    through prefetch credit. Reports publish throughput plus each
+    cursor's committed lag, read off the live queue object."""
+    from chanamq_tpu.amqp.properties import BasicProperties
+    from chanamq_tpu.amqp.value_codec import Timestamp
+    from chanamq_tpu.broker.server import BrokerServer
+    from chanamq_tpu.client import AMQPClient
+    from chanamq_tpu.store.memory import MemoryStore
+
+    qn = "bench_stream"
+    warmup = 2000
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                       store=MemoryStore())
+    await srv.start()
+    conn_p = conn_c = None
+    try:
+        conn_p = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        pch = await conn_p.channel()
+        await pch.confirm_select()
+        await pch.queue_declare(qn, durable=True,
+                                arguments={"x-queue-type": "stream"})
+        props = BasicProperties(delivery_mode=2)
+        pad = b"x" * BODY_BYTES
+
+        # pre-run backlog: only the "first" cursor should replay this
+        for _ in range(warmup):
+            pch.basic_publish(pad, routing_key=qn, properties=props)
+        await pch.wait_unconfirmed_below(1, timeout=30)
+        attach_ts = Timestamp(int(time.time()))
+
+        conn_c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        counts = {"first": 0, "next": 0, "timestamp": 0}
+        channels = {}
+        for cursor, offset_spec in (("first", "first"), ("next", "next"),
+                                    ("timestamp", attach_ts)):
+            ch = await conn_c.channel()
+            await ch.basic_qos(prefetch_count=PREFETCH)
+
+            def on_msg(msg, cursor=cursor, ch=ch):
+                counts[cursor] += 1
+                if counts[cursor] % 500 == 0:
+                    ch.basic_ack(msg.delivery_tag, multiple=True)
+
+            await ch.basic_consume(
+                qn, on_msg, consumer_tag=f"bench-{cursor}",
+                arguments={"x-stream-offset": offset_spec})
+            channels[cursor] = ch
+
+        deadline = time.perf_counter() + BENCH_SECONDS
+        t0 = time.perf_counter()
+        published = 0
+        while time.perf_counter() < deadline:
+            pch.basic_publish(pad, routing_key=qn, properties=props)
+            published += 1
+            if len(pch.unconfirmed) >= CONFIRM_WINDOW:
+                await conn_p.drain()
+                await pch.wait_unconfirmed_below(CONFIRM_WINDOW // 2)
+        await conn_p.drain()
+        await pch.wait_unconfirmed_below(1, timeout=30)
+        publish_rate = published / (time.perf_counter() - t0)
+
+        # drain: every cursor reaches the tail (first also replays warmup)
+        targets = {"first": warmup + published, "next": published,
+                   "timestamp": published}
+        for _ in range(200):
+            if all(counts[c] >= targets[c] for c in counts):
+                break
+            await asyncio.sleep(0.05)
+        run_s = time.perf_counter() - t0
+        for cursor, ch in channels.items():
+            if counts[cursor]:
+                ch.basic_ack(0, multiple=True)
+        await asyncio.sleep(0.3)  # let the final acks commit cursors
+
+        queue = srv.broker.vhosts["/"].queues[qn]
+        lags = {c: queue.cursor_lag(f"bench-{c}") for c in counts}
+        snap = srv.broker.metrics.snapshot()
+        return {
+            "published": published,
+            "published_per_s": round(publish_rate, 1),
+            "delivered": dict(counts),
+            "delivered_per_s_total": round(sum(counts.values()) / run_s, 1),
+            "cursor_lag": lags,
+            "segments": queue.segment_count,
+            "retained_bytes": queue.retained_bytes,
+            "stream_cursor_commits": snap.get("stream_cursor_commits"),
+        }
+    finally:
+        for conn in (conn_c, conn_p):
+            if conn is not None:
+                try:
+                    await conn.close()
+                except Exception:
+                    pass
+        await srv.stop()
+
+
+def run_stream_spec() -> dict:
+    try:
+        return asyncio.run(asyncio.wait_for(_stream_spec(), timeout=120))
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def main() -> None:
     if "--role" in sys.argv:
         import argparse
@@ -578,6 +687,25 @@ def main() -> None:
         else:
             asyncio.run(consumer_main(
                 args.port, bool(args.auto_ack), args.seconds, args.queue))
+        return
+
+    if "--stream" in sys.argv:
+        # stream-queue scenario only: 1 producer, 3 cursors (first / next /
+        # timestamp), manual ack — publish throughput + per-cursor lag
+        result = run_stream_spec()
+        print(f"# stream_1p3c: {result}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "stream_published_msgs_per_s_1p3cursors",
+            "value": result.get("published_per_s"),
+            "unit": "msgs/s",
+            "vs_baseline": None,
+            "delivered_per_s_total": result.get("delivered_per_s_total"),
+            "cursor_lag": result.get("cursor_lag"),
+            "body_bytes": BODY_BYTES,
+            "stream_1p3c": result,
+            **({"error": {"stream_1p3c": result["error"]}}
+               if "error" in result else {}),
+        }))
         return
 
     if "--replicate" in sys.argv:

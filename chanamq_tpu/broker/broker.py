@@ -25,6 +25,8 @@ from ..amqp.value_codec import Timestamp
 from ..cluster.idgen import IdGenerator
 from ..store.api import StoredExchange, StoredMessage, StoredQueue, StoreService
 from ..store.memory import MemoryStore
+from ..streams import VALID_QUEUE_TYPES, StreamQueue
+from ..streams.queue import _parse_max_age_ms
 from ..utils.metrics import Metrics
 from .entities import Exchange, Message, Queue, VHost, now_ms
 
@@ -59,6 +61,10 @@ class Broker:
         memory_low_watermark: Optional[int] = None,
         consumer_timeout_ms: int = 0,
         store_max_bytes: int = 0,
+        stream_segment_bytes: int = 1 << 20,
+        stream_segment_age_s: float = 10.0,
+        stream_cache_segments: int = 4,
+        stream_delivery_batch: int = 128,
     ) -> None:
         self.store = store or MemoryStore()
         self.idgen = IdGenerator(node_id)
@@ -101,6 +107,14 @@ class Broker:
         # (sampled each sweep tick), reopening below 80% of the cap. 0 = off.
         self.store_max_bytes = store_max_bytes or 0
         self.store_bytes = 0  # last sampled store size (gauge)
+        # stream-queue defaults (chana.mq.stream.*): active segments seal at
+        # stream_segment_bytes or after stream_segment_age_s of quiet;
+        # cache_segments bounds resident sealed blobs per stream;
+        # delivery_batch caps records pushed per cursor per dispatch pass
+        self.stream_segment_bytes = stream_segment_bytes or (1 << 20)
+        self.stream_segment_age_s = stream_segment_age_s
+        self.stream_cache_segments = stream_cache_segments
+        self.stream_delivery_batch = stream_delivery_batch or 128
         # publish bodies held at the gate across all connections (gauge;
         # bounded by PARK_BUF_MAX per connection x max-connections)
         self.held_bytes = 0
@@ -330,6 +344,8 @@ class Broker:
     async def _load_stored_queue(self, sq: StoredQueue) -> Queue:
         """Reconstruct one queue (pending + unacked messages) from the store
         (reference: stash-until-Loaded preStart reload, QueueEntity.scala:107-135)."""
+        if sq.arguments.get("x-queue-type") == "stream":
+            return await self._load_stored_stream(sq)
         queue = Queue(
             self, sq.vhost, sq.name, durable=sq.durable,
             auto_delete=sq.auto_delete, ttl_ms=sq.ttl_ms,
@@ -429,6 +445,20 @@ class Broker:
                 sq.vhost, sq.name, queue.last_consumed))
             self.store_bg(self.store.delete_queue_unacks(
                 sq.vhost, sq.name, list(sq.unacks)))
+        return queue
+
+    async def _load_stored_stream(self, sq: StoredQueue) -> StreamQueue:
+        """Reconstruct a stream queue: the sealed-segment index rebuilds
+        from metadata only (blobs hydrate lazily when a cursor reads into
+        them) and committed cursor offsets reload so reconnecting
+        consumers resume where they acked."""
+        queue = StreamQueue(
+            self, sq.vhost, sq.name, durable=sq.durable,
+            arguments=sq.arguments)
+        queue.restore_segments(
+            await self.store.stream_segment_metas(sq.vhost, sq.name))
+        queue.committed = await self.store.select_stream_cursors(
+            sq.vhost, sq.name)
         return queue
 
     async def activate_queue(self, vhost_name: str, name: str) -> Optional[Queue]:
@@ -633,11 +663,29 @@ class Broker:
         arguments = arguments or {}
         self._validate_queue_args(arguments)
         ttl_ms = arguments.get("x-message-ttl")
-        queue = Queue(
-            self, vhost_name, name, durable=durable,
-            exclusive_owner=exclusive_owner, auto_delete=auto_delete,
-            ttl_ms=ttl_ms, arguments=arguments,
-        )
+        if arguments.get("x-queue-type") == "stream":
+            # streams are durable shared logs by definition (RabbitMQ
+            # rejects transient/exclusive/auto-delete stream declares)
+            if not durable:
+                raise BrokerError(
+                    ErrorCode.PRECONDITION_FAILED,
+                    "stream queues must be durable")
+            if exclusive_owner is not None:
+                raise BrokerError(
+                    ErrorCode.PRECONDITION_FAILED,
+                    "stream queues cannot be exclusive")
+            if auto_delete:
+                raise BrokerError(
+                    ErrorCode.PRECONDITION_FAILED,
+                    "stream queues cannot auto-delete")
+            queue: Queue = StreamQueue(
+                self, vhost_name, name, durable=True, arguments=arguments)
+        else:
+            queue = Queue(
+                self, vhost_name, name, durable=durable,
+                exclusive_owner=exclusive_owner, auto_delete=auto_delete,
+                ttl_ms=ttl_ms, arguments=arguments,
+            )
         vhost.queues[name] = queue
         self.invalidate_routes()
         if durable and not exclusive_owner:
@@ -648,7 +696,9 @@ class Broker:
             ))
         if self.cluster is not None and exclusive_owner is None:
             self.cluster._register_meta(queue)
-            if self.cluster.replication is not None:
+            if self.cluster.replication is not None and not queue.is_stream:
+                # per-queue replication mirrors the ready deque; stream
+                # durability is the segment log itself
                 self.cluster.replication.attach(queue)
             self.cluster.broadcast_bg("meta.apply", {
                 "kind": "queue.declared", "vhost": vhost_name, "name": name,
@@ -712,11 +762,47 @@ class Broker:
         """Queue-argument extensions (beyond the reference's x-message-ttl):
         dead-letter routing, length/byte caps, idle expiry. Invalid values
         fail the declare with PRECONDITION_FAILED, RabbitMQ-style."""
+        qtype = arguments.get("x-queue-type")
+        if qtype is not None and qtype not in VALID_QUEUE_TYPES:
+            raise BrokerError(
+                ErrorCode.PRECONDITION_FAILED,
+                f"invalid x-queue-type '{qtype}' "
+                f"(one of {'/'.join(VALID_QUEUE_TYPES)})")
         for arg_name in ("x-message-ttl", "x-max-length", "x-max-length-bytes"):
             v = arguments.get(arg_name)
             if v is not None and (not isinstance(v, int) or v < 0):
                 raise BrokerError(
                     ErrorCode.PRECONDITION_FAILED, f"invalid {arg_name}")
+        if qtype == "stream":
+            try:
+                _parse_max_age_ms(arguments.get("x-max-age"))
+            except ValueError as exc:
+                raise BrokerError(
+                    ErrorCode.PRECONDITION_FAILED, str(exc)) from None
+            seg_bytes = arguments.get("x-stream-max-segment-size-bytes")
+            if seg_bytes is not None and (
+                    not isinstance(seg_bytes, int)
+                    or isinstance(seg_bytes, bool) or seg_bytes <= 0):
+                raise BrokerError(
+                    ErrorCode.PRECONDITION_FAILED,
+                    "invalid x-stream-max-segment-size-bytes")
+            for incompatible in ("x-max-priority", "x-message-ttl",
+                                 "x-dead-letter-exchange", "x-expires",
+                                 "x-single-active-consumer"):
+                if arguments.get(incompatible) is not None:
+                    raise BrokerError(
+                        ErrorCode.PRECONDITION_FAILED,
+                        f"{incompatible} cannot combine with "
+                        "x-queue-type=stream")
+            if arguments.get("x-queue-mode") == "lazy":
+                raise BrokerError(
+                    ErrorCode.PRECONDITION_FAILED,
+                    "x-queue-mode=lazy cannot combine with "
+                    "x-queue-type=stream")
+        elif arguments.get("x-max-age") is not None:
+            raise BrokerError(
+                ErrorCode.PRECONDITION_FAILED,
+                "x-max-age requires x-queue-type=stream")
         expires = arguments.get("x-expires")
         if expires is not None and (not isinstance(expires, int) or expires <= 0):
             raise BrokerError(
@@ -894,7 +980,8 @@ class Broker:
         queue.deleted = True
         del vhost.queues[queue.name]
         self.invalidate_routes()
-        count = len(queue.messages)
+        count = (queue.message_count if queue.is_stream
+                 else len(queue.messages))
         # unbind everywhere (reference broadcasts QueueDeleted on pub-sub);
         # auto-delete sources go through delete_exchange so e2e bindings on
         # both sides are swept and the deletion replicates cluster-wide
@@ -912,6 +999,8 @@ class Broker:
             await self.store.archive_queue(vhost.name, queue.name)
             await self.store.delete_queue(vhost.name, queue.name)
             await self.store.delete_queue_binds(vhost.name, queue.name)
+        if queue.is_stream:
+            await self.store.delete_stream_data(vhost.name, queue.name)
         if self.cluster is not None and queue.exclusive_owner is None:
             if self.cluster.replication is not None:
                 # final "delete" event tears down follower copies
@@ -1210,7 +1299,15 @@ class Broker:
         message.exrk_raw = exrk_raw
         message.refer_count = len(queues)
         self.account_message(message)
-        persist = message.is_persistent and any(q.durable for q in queues)
+        # streams never reference the shared Message after push (the log
+        # copies the bytes into its own record), so they neither persist
+        # the blob nor may a classic sibling passivate the body before the
+        # stream's copy: persistence keys on classic durables only, and
+        # streams go FIRST in the fanout
+        persist = message.is_persistent and any(
+            q.durable and not q.is_stream for q in queues)
+        if len(queues) > 1 and any(q.is_stream for q in queues):
+            queues = sorted(queues, key=lambda q: not q.is_stream)
         if persist:
             message.persisted = True
             self.store.insert_message_nowait(StoredMessage(

@@ -1511,6 +1511,19 @@ class AMQPConnection:
                 ErrorCode.ACCESS_REFUSED,
                 f"queue '{queue.name}' has an exclusive consumer",
                 method.CLASS_ID, method.METHOD_ID)
+        if queue.is_stream:
+            # attach position must be parseable BEFORE ConsumeOk goes out —
+            # a post-Ok failure would leave the client believing it is
+            # subscribed
+            from ..streams import parse_offset_spec
+
+            try:
+                parse_offset_spec(
+                    (method.arguments or {}).get("x-stream-offset"))
+            except ValueError as exc:
+                raise ChannelError(
+                    ErrorCode.PRECONDITION_FAILED, str(exc),
+                    method.CLASS_ID, method.METHOD_ID) from None
         consumer = Consumer(
             tag, channel, queue, method.no_ack, method.exclusive, method.arguments)
         channel.consumers[tag] = consumer
@@ -1551,7 +1564,7 @@ class AMQPConnection:
 
             delivery = Delivery(qm, queue, channel, "", tag, no_ack=False)
             channel.unacked[tag] = delivery
-            queue.outstanding[qm.offset] = delivery
+            queue.note_outstanding(delivery)
             if queue.durable and msg.persisted:
                 # mirror the consume dispatch path: the unacked message must
                 # survive a restart

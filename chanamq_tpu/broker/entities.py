@@ -161,6 +161,10 @@ class Delivery:
 class Queue:
     """One message queue within a vhost."""
 
+    # queue-type discriminant: StreamQueue (streams/queue.py) overrides to
+    # True; broker paths that differ by type branch on this, not isinstance
+    is_stream = False
+
     HYDRATE_BATCH = 128
     # resident head kept in RAM for x-queue-mode=lazy queues: exactly one
     # dispatch hydration batch, so the consumer never stalls on an empty
@@ -774,6 +778,12 @@ class Queue:
             return qm
 
     # -- ack / requeue -----------------------------------------------------
+
+    def note_outstanding(self, delivery: Delivery) -> None:
+        """Register an out-of-dispatch delivery (basic.get) as unacked.
+        Streams key this differently (cursor, offset), so callers go
+        through this hook instead of writing the dict directly."""
+        self.outstanding[delivery.queued.offset] = delivery
 
     def _settle_store(self, delivery: Delivery) -> None:
         self.outstanding.pop(delivery.queued.offset, None)

@@ -201,6 +201,14 @@ class BrokerServer:
                 int(ack_timeout * 1000) if ack_timeout else 0),
             store_max_bytes=config.size_bytes("chana.mq.store.max-bytes")
             or 0,
+            stream_segment_bytes=config.size_bytes(
+                "chana.mq.stream.segment-bytes") or (1 << 20),
+            stream_segment_age_s=config.duration_s(
+                "chana.mq.stream.segment-age") or 0.0,
+            stream_cache_segments=config.int(
+                "chana.mq.stream.cache-segments"),
+            stream_delivery_batch=config.int(
+                "chana.mq.stream.delivery-batch") or 128,
         )
         return cls(
             broker=broker,

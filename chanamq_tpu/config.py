@@ -118,6 +118,18 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.replicate.sync": False,
     "chana.mq.replicate.batch-max": 256,   # events per shipped batch
     "chana.mq.replicate.ack-timeout-ms": 1000,
+    # stream queues (streams/): append-only segmented logs declared with
+    # x-queue-type=stream. The active in-memory segment seals and spills
+    # to the store at segment-bytes or segment-age, whichever first
+    # (x-stream-max-segment-size-bytes overrides the size per queue).
+    "chana.mq.stream.segment-bytes": "1MiB",
+    "chana.mq.stream.segment-age": "10s",
+    # sealed segments kept hot in RAM; replaying cursors reload evicted
+    # blobs from the store one segment at a time
+    "chana.mq.stream.cache-segments": 4,
+    # records one cursor may take per coalesced dispatch pass (fairness
+    # slice across cursors; prefetch credit still gates each delivery)
+    "chana.mq.stream.delivery-batch": 128,
 }
 
 _DURATION_RE = re.compile(r"^\s*([0-9.]+)\s*(ms|s|m|h|d)?\s*$")

@@ -20,6 +20,7 @@ from typing import Optional
 from urllib.parse import unquote
 
 from ..broker.broker import Broker
+from ..store.api import is_replica_vhost
 
 log = logging.getLogger("chanamq.admin")
 
@@ -90,56 +91,75 @@ class AdminServer:
                 pass
 
     async def _route(self, method: str, path: str) -> tuple[str, object]:
-        if method not in ("GET", "POST"):
-            return "405 Method Not Allowed", {"error": "GET/POST only"}
         segments = [unquote(s) for s in path.strip("/").split("/") if s]
-        if segments == ["metrics"] and method == "GET":
-            # conventional Prometheus scrape path (text exposition format);
-            # errors still produce an HTTP response, not a dropped scrape
-            try:
-                return "200 OK", self._prometheus()
-            except Exception as exc:
-                return "500 Internal Server Error", {"error": str(exc)}
-        if not segments or segments[0] != "admin":
+        matched = self._match(segments)
+        if matched is None:
+            # unknown path: 404 regardless of verb
             return "404 Not Found", {"error": "unknown path"}
-        segments = segments[1:]
+        allowed, handler = matched
+        if method != allowed:
+            # KNOWN path, wrong verb: 405 naming the verb that works —
+            # never the blanket 404 that made a POSTed scrape or a GET
+            # mutation attempt indistinguishable from a typo'd path
+            return "405 Method Not Allowed", {"error": f"use {allowed}"}
         try:
-            # vhost mutations (paths mirror the reference's AdminApi, but
-            # require POST: a GET mutation is CSRF-triggerable from any web
-            # page even on localhost)
-            if len(segments) == 3 and segments[0] == "vhost" and segments[1] == "put":
-                if method != "POST":
-                    return "405 Method Not Allowed", {"error": "use POST"}
-                await self.broker.create_vhost(segments[2])
-                return "200 OK", {"ok": True, "vhost": segments[2]}
-            if len(segments) == 3 and segments[0] == "vhost" and segments[1] == "delete":
-                if method != "POST":
-                    return "405 Method Not Allowed", {"error": "use POST"}
-                deleted = await self.broker.delete_vhost(segments[2])
-                return "200 OK", {"ok": deleted, "vhost": segments[2]}
-            if method != "GET":
-                return "405 Method Not Allowed", {"error": "use GET"}
-            # observability
-            if segments == ["metrics"]:
-                return "200 OK", self.broker.metrics_snapshot()
-            if segments == ["overview"]:
-                return "200 OK", self._overview()
-            if len(segments) == 2 and segments[0] == "queues":
-                return "200 OK", self._queues(segments[1])
-            if len(segments) == 2 and segments[0] == "exchanges":
-                return "200 OK", self._exchanges(segments[1])
-            if segments == ["cluster"]:
-                return "200 OK", self._cluster()
-            if segments == ["replication"]:
-                return "200 OK", self._replication()
-            if segments == ["forecast"]:
-                forecaster = getattr(self.broker, "forecaster", None)
-                if forecaster is None:
-                    return "200 OK", {"enabled": False}
-                return "200 OK", forecaster.snapshot()
+            result = handler()
+            if asyncio.iscoroutine(result):
+                result = await result
+            return "200 OK", result
         except Exception as exc:
             return "500 Internal Server Error", {"error": str(exc)}
-        return "404 Not Found", {"error": "unknown path"}
+
+    def _match(self, segments: list):
+        """Resolve a path to (allowed_method, handler) or None. Handlers
+        may be sync or async; mutations require POST (a GET mutation is
+        CSRF-triggerable from any web page even on localhost), reads GET.
+        Paths mirror the reference's AdminApi plus the observability
+        endpoints it lacked."""
+        if segments == ["metrics"]:
+            # conventional Prometheus scrape path (text exposition format)
+            return ("GET", self._prometheus)
+        if not segments or segments[0] != "admin":
+            return None
+        rest = segments[1:]
+        if len(rest) == 3 and rest[0] == "vhost":
+            name = rest[2]
+            if rest[1] == "put":
+                return ("POST", lambda: self._vhost_put(name))
+            if rest[1] == "delete":
+                return ("POST", lambda: self._vhost_delete(name))
+            return None
+        if rest == ["metrics"]:
+            return ("GET", self.broker.metrics_snapshot)
+        if rest == ["overview"]:
+            return ("GET", self._overview)
+        if len(rest) == 2 and rest[0] == "queues":
+            return ("GET", lambda: self._queues(rest[1]))
+        if len(rest) == 2 and rest[0] == "exchanges":
+            return ("GET", lambda: self._exchanges(rest[1]))
+        if rest == ["streams"]:
+            return ("GET", self._streams)
+        if rest == ["cluster"]:
+            return ("GET", self._cluster)
+        if rest == ["replication"]:
+            return ("GET", self._replication)
+        if rest == ["forecast"]:
+            return ("GET", self._forecast)
+        return None
+
+    async def _vhost_put(self, name: str) -> dict:
+        await self.broker.create_vhost(name)
+        return {"ok": True, "vhost": name}
+
+    async def _vhost_delete(self, name: str) -> dict:
+        deleted = await self.broker.delete_vhost(name)
+        return {"ok": deleted, "vhost": name}
+
+    def _forecast(self):
+        forecaster = getattr(self.broker, "forecaster", None)
+        if forecaster is None:
+            return {"enabled": False}
+        return forecaster.snapshot()
 
     # metric name -> prometheus type; everything else in the snapshot is a
     # gauge. Latency percentiles are exported as computed gauges (the
@@ -152,6 +172,9 @@ class AdminServer:
         "repl_events_shipped", "repl_batches_shipped",
         "repl_events_applied", "repl_resyncs", "repl_promotions",
         "repl_ack_timeouts",
+        "stream_appends", "stream_append_bytes", "stream_segments_sealed",
+        "stream_segments_truncated", "stream_records_delivered",
+        "stream_cursor_commits",
     })
 
     @staticmethod
@@ -189,6 +212,32 @@ class AdminServer:
                     f"chanamq_queue_unacked{labels} {len(queue.outstanding)}")
                 out.append(
                     f"chanamq_queue_consumers{labels} {queue.consumer_count}")
+        streams = [
+            (vhost, queue)
+            for vhost in self.broker.vhosts.values()
+            if not is_replica_vhost(vhost.name)
+            for queue in vhost.queues.values() if queue.is_stream
+        ]
+        if streams:
+            out.append("# TYPE chanamq_stream_retained_bytes gauge")
+            out.append("# TYPE chanamq_stream_segments gauge")
+            out.append("# TYPE chanamq_stream_cursor_lag gauge")
+            for vhost, queue in streams:
+                vl = self._prom_label(vhost.name)
+                labels = f'{{vhost="{vl}",queue="{self._prom_label(queue.name)}"}}'
+                out.append(
+                    f"chanamq_stream_retained_bytes{labels} "
+                    f"{queue.retained_bytes}")
+                out.append(
+                    f"chanamq_stream_segments{labels} {queue.segment_count}")
+                for cursor in sorted(queue.committed):
+                    clabels = (
+                        f'{{vhost="{vl}",'
+                        f'queue="{self._prom_label(queue.name)}",'
+                        f'cursor="{self._prom_label(cursor)}"}}')
+                    out.append(
+                        f"chanamq_stream_cursor_lag{clabels} "
+                        f"{queue.cursor_lag(cursor)}")
         forecaster = getattr(self.broker, "forecaster", None)
         if forecaster is not None and forecaster.forecast is not None:
             # next-tick telemetry forecast (models/service.py): one gauge
@@ -238,6 +287,43 @@ class AdminServer:
             }
             for queue in vhost.queues.values()
         ]
+
+    def _streams(self) -> list:
+        """Every stream queue across vhosts: log shape (segments, retained
+        bytes, offset range) plus per-cursor committed offset and lag.
+        Replica namespaces are invisible here by construction (they never
+        enter broker.vhosts) and excluded defensively anyway."""
+        out = []
+        for vhost in self.broker.vhosts.values():
+            if is_replica_vhost(vhost.name):
+                continue
+            for queue in vhost.queues.values():
+                if not queue.is_stream:
+                    continue
+                # live cursors may not have committed yet; committed
+                # cursors may have detached — report the union
+                names = set(queue.committed) | set(queue._cursors)
+                out.append({
+                    "vhost": vhost.name,
+                    "name": queue.name,
+                    "segments": queue.segment_count,
+                    "retained_bytes": queue.retained_bytes,
+                    "first_offset": queue.first_offset,
+                    "next_offset": queue.next_offset,
+                    "messages": queue.message_count,
+                    "consumers": queue.consumer_count,
+                    "max_length_bytes": queue.max_length_bytes,
+                    "max_age_ms": queue.max_age_ms,
+                    "cursors": {
+                        name: {
+                            "committed": queue.committed.get(name),
+                            "attached": name in queue._cursors,
+                            "lag": queue.cursor_lag(name),
+                        }
+                        for name in sorted(names)
+                    },
+                })
+        return out
 
     def _cluster(self) -> dict:
         """Cluster membership + queue ownership as the operator sees it

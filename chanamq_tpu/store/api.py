@@ -329,6 +329,51 @@ class StoreService:
     async def purge_queue_msgs(self, vhost: str, queue: str) -> None:
         raise NotImplementedError
 
+    # -- stream segments + cursors (streams/: no reference analogue — the
+    #    reference has no log queues). Sealed segments persist as one blob
+    #    row each; cursors are the server-tracked committed offsets, keyed
+    #    by consumer tag, that let reconnecting stream readers resume. ----
+
+    async def insert_stream_segment(
+        self, vhost: str, queue: str, base_offset: int, last_offset: int,
+        first_ts_ms: int, last_ts_ms: int, size_bytes: int, blob: bytes,
+    ) -> None:
+        raise NotImplementedError
+
+    async def select_stream_segment(
+        self, vhost: str, queue: str, base_offset: int
+    ) -> Optional[bytes]:
+        raise NotImplementedError
+
+    async def stream_segment_metas(
+        self, vhost: str, queue: str
+    ) -> list[tuple[int, int, int, int, int]]:
+        """Segment index in base-offset order, blobs omitted:
+        (base_offset, last_offset, first_ts_ms, last_ts_ms, size_bytes).
+        Recovery rebuilds the in-memory log from this alone."""
+        raise NotImplementedError
+
+    async def delete_stream_segments(
+        self, vhost: str, queue: str, base_offsets: list[int]
+    ) -> None:
+        """Whole-segment truncation (retention / purge)."""
+        raise NotImplementedError
+
+    async def update_stream_cursor(
+        self, vhost: str, queue: str, name: str, committed_offset: int
+    ) -> None:
+        raise NotImplementedError
+
+    async def select_stream_cursors(
+        self, vhost: str, queue: str
+    ) -> dict[str, int]:
+        """cursor name -> committed offset."""
+        raise NotImplementedError
+
+    async def delete_stream_data(self, vhost: str, queue: str) -> None:
+        """Drop ALL of a stream's segments and cursors (queue delete)."""
+        raise NotImplementedError
+
     # -- exchanges + binds (reference: insertExchange/selectExchange/
     #    deleteExchange, insertExchangeBind/deleteExchangeBind) ------------
 

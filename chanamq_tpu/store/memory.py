@@ -44,6 +44,13 @@ class MemoryStore(StoreService):
         self.exchanges: dict[tuple[str, str], StoredExchange] = {}
         self.vhosts: dict[str, bool] = {}
         self.archived: dict[tuple[str, str], StoredQueue] = {}
+        # stream log: (vhost, queue) -> {base_offset: (meta..., blob)}
+        # where meta is (base, last, first_ts_ms, last_ts_ms, size_bytes)
+        self.stream_segments: dict[
+            tuple[str, str], dict[int, tuple[int, int, int, int, int, bytes]]
+        ] = {}
+        # (vhost, queue) -> {cursor name: committed offset}
+        self.stream_cursors: dict[tuple[str, str], dict[str, int]] = {}
         self._next_worker_id = 0
         self._data_bytes = 0  # running sum of stored body bytes
 
@@ -202,6 +209,52 @@ class MemoryStore(StoreService):
         q = self.queues.get((vhost, queue))
         if q:
             q.msgs = []
+        return _DONE
+
+    # -- stream segments + cursors -----------------------------------------
+
+    def insert_stream_segment(self, vhost, queue, base_offset, last_offset,
+                              first_ts_ms, last_ts_ms, size_bytes, blob):
+        segs = self.stream_segments.setdefault((vhost, queue), {})
+        old = segs.get(base_offset)
+        if old is not None:
+            self._data_bytes -= len(old[5])
+        segs[base_offset] = (base_offset, last_offset, first_ts_ms,
+                             last_ts_ms, size_bytes, blob)
+        self._data_bytes += len(blob)
+        return _DONE
+
+    async def select_stream_segment(self, vhost, queue, base_offset):
+        seg = self.stream_segments.get((vhost, queue), {}).get(base_offset)
+        return seg[5] if seg else None
+
+    async def stream_segment_metas(self, vhost, queue):
+        segs = self.stream_segments.get((vhost, queue), {})
+        return [seg[:5] for _, seg in sorted(segs.items())]
+
+    def delete_stream_segments(self, vhost, queue, base_offsets):
+        segs = self.stream_segments.get((vhost, queue))
+        if segs:
+            for base in base_offsets:
+                old = segs.pop(base, None)
+                if old is not None:
+                    self._data_bytes -= len(old[5])
+        return _DONE
+
+    def update_stream_cursor(self, vhost, queue, name, committed_offset):
+        self.stream_cursors.setdefault(
+            (vhost, queue), {})[name] = committed_offset
+        return _DONE
+
+    async def select_stream_cursors(self, vhost, queue):
+        return dict(self.stream_cursors.get((vhost, queue), {}))
+
+    def delete_stream_data(self, vhost, queue):
+        segs = self.stream_segments.pop((vhost, queue), None)
+        if segs:
+            for seg in segs.values():
+                self._data_bytes -= len(seg[5])
+        self.stream_cursors.pop((vhost, queue), None)
         return _DONE
 
     # -- exchanges + binds -------------------------------------------------

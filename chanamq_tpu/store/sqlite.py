@@ -78,6 +78,15 @@ CREATE TABLE IF NOT EXISTS exchange_binds (
   vhost TEXT, exchange TEXT, destination TEXT, routing_key TEXT, arguments TEXT,
   PRIMARY KEY (vhost, exchange, destination, routing_key)
 );
+CREATE TABLE IF NOT EXISTS stream_segments (
+  vhost TEXT, queue TEXT, base_offset INTEGER, last_offset INTEGER,
+  first_ts_ms INTEGER, last_ts_ms INTEGER, size_bytes INTEGER, blob BLOB,
+  PRIMARY KEY (vhost, queue, base_offset)
+);
+CREATE TABLE IF NOT EXISTS stream_cursors (
+  vhost TEXT, queue TEXT, name TEXT, committed_offset INTEGER,
+  PRIMARY KEY (vhost, queue, name)
+);
 CREATE TABLE IF NOT EXISTS vhosts (name TEXT PRIMARY KEY, active INTEGER);
 CREATE TABLE IF NOT EXISTS cluster_kv (key TEXT PRIMARY KEY, value INTEGER);
 CREATE TABLE IF NOT EXISTS queue_metas_deleted (
@@ -672,6 +681,57 @@ class SqliteStore(StoreService):
     def purge_queue_msgs(self, vhost, queue):
         return self._submit(lambda db: db.execute(
             "DELETE FROM queue_msgs WHERE vhost=? AND queue=?", (vhost, queue)), guard=False)
+
+    # -- stream segments + cursors -----------------------------------------
+
+    def insert_stream_segment(self, vhost, queue, base_offset, last_offset,
+                              first_ts_ms, last_ts_ms, size_bytes, blob):
+        row = (vhost, queue, base_offset, last_offset, first_ts_ms,
+               last_ts_ms, size_bytes, blob)
+        return self._submit(lambda db: db.execute(
+            "INSERT OR REPLACE INTO stream_segments VALUES (?,?,?,?,?,?,?,?)",
+            row), guard=False)
+
+    async def select_stream_segment(self, vhost, queue, base_offset):
+        row = await self._submit(lambda db: db.execute(
+            "SELECT blob FROM stream_segments "
+            "WHERE vhost=? AND queue=? AND base_offset=?",
+            (vhost, queue, base_offset)).fetchone(), guard=False)
+        return row[0] if row is not None else None
+
+    async def stream_segment_metas(self, vhost, queue):
+        rows = await self._submit(lambda db: db.execute(
+            "SELECT base_offset, last_offset, first_ts_ms, last_ts_ms, "
+            "size_bytes FROM stream_segments WHERE vhost=? AND queue=? "
+            "ORDER BY base_offset", (vhost, queue)).fetchall(), guard=False)
+        return [tuple(r) for r in rows]
+
+    def delete_stream_segments(self, vhost, queue, base_offsets):
+        return self._submit(lambda db: db.executemany(
+            "DELETE FROM stream_segments "
+            "WHERE vhost=? AND queue=? AND base_offset=?",
+            [(vhost, queue, b) for b in base_offsets]), guard=False)
+
+    def update_stream_cursor(self, vhost, queue, name, committed_offset):
+        return self._submit(lambda db: db.execute(
+            "INSERT OR REPLACE INTO stream_cursors VALUES (?,?,?,?)",
+            (vhost, queue, name, committed_offset)), guard=False)
+
+    async def select_stream_cursors(self, vhost, queue):
+        rows = await self._submit(lambda db: db.execute(
+            "SELECT name, committed_offset FROM stream_cursors "
+            "WHERE vhost=? AND queue=?", (vhost, queue)).fetchall(),
+            guard=False)
+        return {r[0]: r[1] for r in rows}
+
+    def delete_stream_data(self, vhost, queue):
+        def w(db: sqlite3.Connection):
+            db.execute("DELETE FROM stream_segments WHERE vhost=? AND queue=?",
+                       (vhost, queue))
+            db.execute("DELETE FROM stream_cursors WHERE vhost=? AND queue=?",
+                       (vhost, queue))
+
+        return self._submit(w)
 
     # -- exchanges + binds -------------------------------------------------
 

@@ -77,6 +77,15 @@ class Metrics:
         self.repl_promotions = 0
         self.repl_ack_timeouts = 0
         self.repl_ack_us = Histogram()
+        # stream queues (streams/): append/seal/truncate volume plus
+        # cursor activity (deliveries count records read, commits count
+        # monotonic cursor advances on ack)
+        self.stream_appends = 0
+        self.stream_append_bytes = 0
+        self.stream_segments_sealed = 0
+        self.stream_segments_truncated = 0
+        self.stream_records_delivered = 0
+        self.stream_cursor_commits = 0
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -115,4 +124,10 @@ class Metrics:
             "repl_ack_p50_us": self.repl_ack_us.percentile_us(0.50),
             "repl_ack_p99_us": self.repl_ack_us.percentile_us(0.99),
             "repl_ack_mean_us": self.repl_ack_us.mean_us,
+            "stream_appends": self.stream_appends,
+            "stream_append_bytes": self.stream_append_bytes,
+            "stream_segments_sealed": self.stream_segments_sealed,
+            "stream_segments_truncated": self.stream_segments_truncated,
+            "stream_records_delivered": self.stream_records_delivered,
+            "stream_cursor_commits": self.stream_cursor_commits,
         }

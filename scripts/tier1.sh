@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Tier-1 gate: the exact ROADMAP.md verify line, then a short stream-bench
+# smoke so the segmented-log dispatch path gets exercised end to end
+# (bench.py --stream: 1 producer, 3 cursors at first/next/timestamp).
+set -u
+cd "$(dirname "$0")/.."
+
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+if [ "$rc" -ne 0 ]; then
+    echo "tier1: pytest FAILED (rc=$rc)" >&2
+    exit "$rc"
+fi
+
+echo "tier1: stream bench smoke (5 s)"
+BENCH_SECONDS=5 timeout -k 10 120 python bench.py --stream || {
+    rc=$?
+    echo "tier1: stream bench smoke FAILED (rc=$rc)" >&2
+    exit "$rc"
+}
+echo "tier1: OK"

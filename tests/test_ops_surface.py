@@ -146,6 +146,24 @@ async def test_admin_unknown_path_404(stack):
     assert status == 404
 
 
+async def test_admin_known_path_wrong_method_405(stack):
+    _, admin = stack
+    # known GET paths refuse POST with 405 (not a blanket 404) and name
+    # the allowed method in the body
+    status, body = await http_req(admin.bound_port, "/metrics", "POST")
+    assert status == 405 and body["error"] == "use GET"
+    status, body = await http_req(admin.bound_port, "/admin/overview", "POST")
+    assert status == 405 and body["error"] == "use GET"
+    status, body = await http_req(admin.bound_port, "/admin/streams", "POST")
+    assert status == 405
+    # mutating vhost paths refuse GET the same way
+    status, body = await http_req(admin.bound_port, "/admin/vhost/put/x")
+    assert status == 405 and body["error"] == "use POST"
+    # unknown paths keep 404 regardless of method
+    status, _ = await http_req(admin.bound_port, "/admin/nope", "POST")
+    assert status == 404
+
+
 # ---------------------------------------------------------------------------
 # TLS (AMQPS)
 # ---------------------------------------------------------------------------
