@@ -434,9 +434,18 @@ async def _cluster_spec() -> dict:
         loop = asyncio.get_event_loop()
         got = 0
         done = loop.create_future()
+        lat_ns: list = []
+        paced_n = 500
+        paced_done = loop.create_future()
+        phase = {"paced": False}
 
         def cb(m):
             nonlocal got
+            if phase["paced"]:
+                lat_ns.append(time.perf_counter_ns() - int(bytes(m.body[:19])))
+                if len(lat_ns) >= paced_n and not paced_done.done():
+                    paced_done.set_result(None)
+                return
             got += 1
             if got >= n and not done.done():
                 done.set_result(None)
@@ -445,11 +454,45 @@ async def _cluster_spec() -> dict:
         await ch.basic_consume(qn, cb, no_ack=True)
         await asyncio.wait_for(done, 60)
         consume_rate = n / (time.perf_counter() - t0)
+
+        # paced latency phase: publish -> remote push -> owner dispatch ->
+        # remote deliver -> origin render, timed end to end off one clock
+        # (both nodes are in-process). ~1k msgs/s, far below saturation, so
+        # this measures the interconnect's added latency, not queueing.
+        phase["paced"] = True
+        stamp_pad = 19  # perf_counter_ns as fixed-width decimal
+        for _ in range(paced_n):
+            stamp = str(time.perf_counter_ns()).rjust(stamp_pad, "0").encode()
+            ch.basic_publish(stamp + body, routing_key=qn)
+            await asyncio.sleep(0.001)
+        await asyncio.wait_for(paced_done, 60)
+        lat_ns.sort()
         await c.close()
+
+        am, bm = a_srv.broker.metrics, b_srv.broker.metrics
         return {
             "publish_via_nonowner_msgs_per_s": round(publish_rate, 1),
             "remote_consume_msgs_per_s": round(consume_rate, 1),
+            "remote_p50_us": round(lat_ns[len(lat_ns) // 2] / 1000, 1),
+            "remote_p99_us": round(
+                lat_ns[min(len(lat_ns) - 1, int(len(lat_ns) * 0.99))] / 1000, 1),
             "messages": n,
+            "interconnect": {
+                "push_records": am.rpc_push_records,
+                "push_batches": am.rpc_push_batches,
+                "deliver_records": bm.rpc_deliver_records,
+                "deliver_batches": bm.rpc_deliver_batches,
+                "settle_records": am.rpc_settle_records,
+                "settle_batches": am.rpc_settle_batches,
+                "data_bytes_sent": am.rpc_data_bytes_sent + bm.rpc_data_bytes_sent,
+                "data_bytes_recv": am.rpc_data_bytes_recv + bm.rpc_data_bytes_recv,
+                "flushes": {
+                    "window": am.rpc_flush_window + bm.rpc_flush_window,
+                    "bytes": am.rpc_flush_bytes + bm.rpc_flush_bytes,
+                    "count": am.rpc_flush_count + bm.rpc_flush_count,
+                    "demand": am.rpc_flush_demand + bm.rpc_flush_demand,
+                },
+            },
         }
     finally:
         for part in (b_cl, b_srv, a_cl, a_srv):
@@ -706,6 +749,30 @@ def main() -> None:
             **({"error": {"stream_1p3c": result["error"]}}
                if "error" in result else {}),
         }))
+        return
+
+    if "--cluster" in sys.argv:
+        # cluster scenario only: 2 in-process nodes, burst publish via the
+        # non-owner + remote consume + paced remote latency — the
+        # interconnect fast path as its own BENCH line
+        result = run_cluster_spec()
+        print(f"# cluster_2node: {result}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "cluster_publish_via_nonowner_msgs_per_s",
+            "value": result.get("publish_via_nonowner_msgs_per_s"),
+            "unit": "msgs/s",
+            "vs_baseline": None,
+            "remote_consume_msgs_per_s":
+                result.get("remote_consume_msgs_per_s"),
+            "remote_p50_us": result.get("remote_p50_us"),
+            "remote_p99_us": result.get("remote_p99_us"),
+            "body_bytes": BODY_BYTES,
+            "cluster_2node": result,
+            **({"error": {"cluster_2node": result["error"]}}
+               if "error" in result else {}),
+        }))
+        if "error" in result:
+            sys.exit(1)  # the tier-1 smoke must fail loudly
         return
 
     if "--replicate" in sys.argv:

@@ -32,11 +32,13 @@ class Frame:
     payload: bytes
 
     def to_bytes(self) -> bytes:
-        return (
-            _HEADER_STRUCT.pack(self.type, self.channel, len(self.payload))
-            + self.payload
-            + b"\xce"
-        )
+        # join, not +: payload may be a memoryview (cluster data-plane
+        # bodies are zero-copy slices of the peer's read buffer)
+        return b"".join((
+            _HEADER_STRUCT.pack(self.type, self.channel, len(self.payload)),
+            self.payload,
+            b"\xce",
+        ))
 
     @staticmethod
     def method(channel: int, payload: bytes) -> "Frame":

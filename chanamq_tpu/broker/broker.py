@@ -153,6 +153,13 @@ class Broker:
         # connection's publish-args cache).
         self._route_cache: Optional[dict[tuple[str, str, str], list[Queue]]] = {}
         self._route_cache_strikes = 0
+        # clustered twin of _route_cache: (vhost, exchange, rk) ->
+        # (local Queue objects, [(owner, names, encoded meta head)]).
+        # Invalidation additionally hooks cluster metadata/membership
+        # mutations (ClusterNode calls invalidate_routes on those).
+        self._cluster_route_cache: Optional[
+            dict[tuple[str, str, str], tuple[list, list]]] = {}
+        self._cluster_route_strikes = 0
 
     _ROUTE_CACHE_MAX = 4096
     _ROUTE_CACHE_STRIKES = 4
@@ -161,6 +168,10 @@ class Broker:
         """Topology changed: cached publish routes are stale."""
         if self._route_cache:
             self._route_cache.clear()
+        if self._cluster_route_cache:
+            self._cluster_route_cache.clear()
+        if self.cluster is not None:
+            self.cluster.resolve_cache.clear()
 
     def spawn(self, coro: Awaitable) -> None:
         """Fire-and-forget a coroutine with a strong reference held until
@@ -1221,6 +1232,43 @@ class Broker:
             queues, exchange_name, routing_key, properties,
             body, immediate, header_raw, marks, exrk_raw)
 
+    def cluster_route_cached(
+        self, vhost_name: str, exchange_name: str, routing_key: str,
+    ) -> bool:
+        """Whether publish_clustered_fast will hit for this route (checked
+        before arming a confirm so a miss has zero side effects)."""
+        cache = self._cluster_route_cache
+        return cache is not None \
+            and (vhost_name, exchange_name, routing_key) in cache
+
+    def publish_clustered_fast(
+        self, vhost_name: str, exchange_name: str, routing_key: str,
+        properties: BasicProperties, body: bytes,
+        header_raw: Optional[bytes],
+        marks: Optional[list[tuple[int, int]]], pending: list,
+    ) -> tuple[bool, bool]:
+        """publish() for the clustered pipelined case on a route-cache hit:
+        identical semantics to _publish_clustered's pending branch (plain
+        publish, no mandatory/immediate), as a plain call — no coroutine,
+        no exchange walk, no ring hashing, and the push-record meta head
+        comes pre-encoded from the cache. Callers must check
+        cluster_route_cached first."""
+        local, remote = self._cluster_route_cache[
+            (vhost_name, exchange_name, routing_key)]
+        self.metrics.published(len(body))
+        if not local and not remote:
+            return (False, True)
+        props_raw = header_raw if header_raw is not None \
+            else properties.encode_header(len(body))
+        for owner, names, head in remote:
+            pending.append((owner, (
+                vhost_name, names, exchange_name, routing_key,
+                props_raw, body, head)))
+        if local:
+            self.push_local(local, properties, body, exchange_name,
+                            routing_key, props_raw, marks)
+        return (True, True)
+
     def _publish_route(
         self, vhost_name: str, exchange_name: str, routing_key: str,
         properties: BasicProperties,
@@ -1355,6 +1403,29 @@ class Broker:
             else:
                 owner = self.cluster.queue_owner(vhost.name, name)
                 by_owner.setdefault(owner, []).append(name)
+        cache = self._cluster_route_cache
+        if cache is not None and pending is not None \
+                and not mandatory and not immediate:
+            exchange = vhost.exchanges.get(exchange_name)
+            if exchange_name == "" or (
+                exchange is not None
+                and exchange.ex_matcher is None
+                and exchange.alternate is None
+                and exchange.type != "headers"
+            ):
+                from ..cluster.dataplane import encode_push_meta_head
+                remote = [
+                    (owner, names, encode_push_meta_head(
+                        vhost.name, names, exchange_name, routing_key))
+                    for owner, names in by_owner.items()]
+                if len(cache) >= self._ROUTE_CACHE_MAX:
+                    cache.clear()
+                    self._cluster_route_strikes += 1
+                    if self._cluster_route_strikes >= self._ROUTE_CACHE_STRIKES:
+                        self._cluster_route_cache = None
+                if self._cluster_route_cache is not None:
+                    cache[(vhost.name, exchange_name, routing_key)] = (
+                        list(local), remote)
         if not local and not by_owner:
             return (False, True)
         props_raw = header_raw if header_raw is not None \
@@ -1379,17 +1450,16 @@ class Broker:
         pushed_remote = False
         if pending is not None and not mandatory and not immediate:
             # pipelined: buffer the push record; the caller's batch barrier
-            # sends one queue.push_many per owner and awaits it — per-batch
-            # RPC round trips instead of per-message. routed is reported
-            # optimistically; a failed push surfaces at the barrier
-            # (confirm-mode: connection error, never a false confirm; else
-            # best-effort, logged)
+            # submits them to the binary data plane and awaits the covering
+            # micro-batches — per-batch round trips instead of per-message,
+            # and the body bytes ride by reference all the way to the
+            # socket. routed is reported optimistically; a failed push
+            # surfaces at the barrier (confirm-mode: connection error,
+            # never a false confirm; else best-effort, logged)
             for owner, names in by_owner.items():
-                pending.append((owner, {
-                    "vhost": vhost.name, "queues": names,
-                    "props_raw": props_raw, "body": body,
-                    "exchange": exchange_name, "routing_key": routing_key,
-                }))
+                pending.append((owner, (
+                    vhost.name, names, exchange_name, routing_key,
+                    props_raw, body)))
                 pushed_remote = True
         else:
             for owner, names in by_owner.items():

@@ -220,6 +220,9 @@ class AMQPConnection:
         self._remote_pending: list = []
         self._remote_strict = False
         self._remote_failures: list = []
+        # tail of the ordered background chain pipelining remote-push
+        # round trips past the read loop (see _batch_barrier)
+        self._remote_chain: Optional[asyncio.Task] = None
 
     # ------------------------------------------------------------------
     # output path
@@ -486,8 +489,7 @@ class AMQPConnection:
             else:
                 if not await self._consume_feed(self._parser.feed(data)):
                     return
-            await self._confirm_barrier()
-            self._flush_confirms()
+            await self._batch_barrier()
 
     async def _run_command(self, out: AMQCommand) -> bool:
         """Dispatch one assembled command with the connection's error
@@ -604,8 +606,10 @@ class AMQPConnection:
 
     @property
     def _fast_path(self) -> bool:
-        return (self._opened and self.broker.cluster is None
-                and not self._closing_channels)
+        # clustered connections take it too: _fused_publish falls back on
+        # a cluster-route-cache miss, and _fused_ack settles through the
+        # same channel.ack the generic arm uses (remote settles buffer)
+        return self._opened and not self._closing_channels
 
     def _fused_publish(
         self, raw, i, n, types, channels, offsets, lengths
@@ -698,18 +702,102 @@ class AMQPConnection:
         # count the skip before publish: the except handlers in
         # _consume_scan resume past this publish's frames on soft errors
         self._fused_skip = consumed
-        seq = self._arm_confirm(channel)
-        self.broker.publish_sync(
-            self.vhost_name, exchange, routing_key, props, body,
-            header_raw=header,
-            marks=self._confirm_marks if seq is not None else None,
-            exrk_raw=exrk_raw,
-        )
+        broker = self.broker
+        if broker.cluster is None:
+            seq = self._arm_confirm(channel)
+            broker.publish_sync(
+                self.vhost_name, exchange, routing_key, props, body,
+                header_raw=header,
+                marks=self._confirm_marks if seq is not None else None,
+                exrk_raw=exrk_raw,
+            )
+        else:
+            # clustered: fused only on a route-cache hit (checked before
+            # arming the confirm, so a miss has no side effects) — the
+            # generic path resolves the route once and fills the cache
+            if not broker.cluster_route_cached(
+                    self.vhost_name, exchange, routing_key):
+                return 0
+            seq = self._arm_confirm(channel)
+            pending = self._remote_pending
+            buffered_before = len(pending)
+            broker.publish_clustered_fast(
+                self.vhost_name, exchange, routing_key, props, body,
+                header,
+                self._confirm_marks if seq is not None else None,
+                pending)
+            if seq is not None and len(pending) > buffered_before:
+                self._remote_strict = True
         if seq is not None:
             # coalesce: one Basic.Ack(multiple=true) per read batch
             self._pending_confirms[channel_id] = seq
             self.broker.metrics.confirmed_msgs += 1
         return consumed
+
+    async def _batch_barrier(self) -> None:
+        """Per-read-batch barrier. When ONLY pipelined remote pushes gate
+        this batch's confirms (no local store marks, no sync replication),
+        the round trip is offloaded to an ordered background chain and the
+        read loop keeps parsing the next batch — read batches pipeline
+        through the data plane's per-stream windows instead of stalling
+        the whole connection one RTT each. Anything needing the store or
+        replication barrier takes the synchronous path below."""
+        cluster = self.broker.cluster
+        if (self._remote_pending and not self._confirm_marks
+                and not self._remote_failures
+                and (cluster.replication is None
+                     or not cluster.replication.sync)):
+            records, self._remote_pending = self._remote_pending, []
+            strict, self._remote_strict = self._remote_strict, False
+            confirms, self._pending_confirms = self._pending_confirms, {}
+            # submit NOW (sync): the RPCs hit the wire while this batch's
+            # barrier rides the background chain — successive read batches
+            # keep the per-stream in-flight windows full instead of
+            # alternating parse / round-trip
+            futures = cluster.submit_batch(records)
+            prev = self._remote_chain
+            self._remote_chain = asyncio.get_event_loop().create_task(
+                self._remote_confirm_chain(prev, futures, strict, confirms))
+            return
+        await self._confirm_barrier()
+        self._flush_confirms()
+
+    async def _remote_confirm_chain(
+        self, prev: Optional[asyncio.Task], futures: set, strict: bool,
+        confirms: dict,
+    ) -> None:
+        """One offloaded batch: await the previous batch (confirm order —
+        a later multiple=true ack would cover an earlier batch's seqs),
+        barrier on the already-submitted pushes, then release this batch's
+        confirms. A strict failure kills the connection like a failed
+        store barrier would — never a false confirm."""
+        if prev is not None:
+            await prev
+        try:
+            failures = await self.broker.cluster.await_batch(futures)
+        except Exception as exc:  # pragma: no cover - await_batch collects
+            failures = [exc]
+        if failures:
+            if strict:
+                log.warning(
+                    "remote push failed under confirm barrier: %r; "
+                    "dropping connection %d", failures[0], self.id)
+                for failure in failures:
+                    self._remote_failures.append((failure, False))
+                try:
+                    self.writer.transport.abort()
+                except Exception:
+                    pass
+                return
+            for failure in failures:
+                log.warning("remote push failed (best-effort publish): %r",
+                            failure)
+        if self.closing:
+            return
+        for channel_id, max_seq in confirms.items():
+            if channel_id in self.channels:
+                self.send_method(channel_id, am.Basic.Ack(
+                    delivery_tag=max_seq, multiple=True))
 
     async def _confirm_barrier(self) -> None:
         """Durability barrier before releasing publisher confirms: a confirm
@@ -736,7 +824,7 @@ class AMQPConnection:
         a failure covering a confirm-armed (or tx-commit) publish escalates
         — never acknowledge over a lost remote push; best-effort failures
         just log (shared by the confirm barrier and tx.commit)."""
-        if self._remote_pending:
+        if self._remote_pending or self._remote_chain is not None:
             await self._drain_remote()
         if self._remote_failures:
             failures, self._remote_failures = self._remote_failures, []
@@ -752,13 +840,24 @@ class AMQPConnection:
                             failure)
 
     async def _drain_remote(self) -> None:
-        """Flush buffered remote push records: one queue.push_many RPC per
-        owner, awaited to completion. Failures collect for the barrier,
-        tagged with whether a confirm-armed publish was in the drained
-        batch (strictness is per-drain: a batched RPC can't attribute a
-        failure to individual records inside it)."""
+        """Flush buffered remote push records through the data plane,
+        awaited to completion — including any offloaded batches still in
+        the background chain (in-channel ordering: a basic.get right after
+        a publish must see the publish applied on the owner). Failures
+        collect for the barrier, tagged with whether a confirm-armed
+        publish was in the drained batch (strictness is per-drain: a
+        batched RPC can't attribute a failure to individual records)."""
+        chain = self._remote_chain
+        if chain is not None:
+            try:
+                await chain
+            finally:
+                if self._remote_chain is chain:
+                    self._remote_chain = None
         records, self._remote_pending = self._remote_pending, []
         strict, self._remote_strict = self._remote_strict, False
+        if not records:
+            return
         for failure in await self.broker.cluster.push_batch(records):
             self._remote_failures.append((failure, strict))
 
@@ -829,10 +928,10 @@ class AMQPConnection:
                     self.broker.held_bytes -= self._held_cost(command)
             self._held.clear()
             self._held_bytes = 0
-        # buffered pipelined remote pushes: send them (the broker accepted
-        # these publishes pre-teardown; dropping them would lose messages)
-        # and log any failures best-effort
-        if self._remote_pending:
+        # buffered/chained pipelined remote pushes: send them (the broker
+        # accepted these publishes pre-teardown; dropping them would lose
+        # messages) and log any failures best-effort
+        if self._remote_pending or self._remote_chain is not None:
             try:
                 await self._drain_remote()
             except Exception as exc:  # pragma: no cover - teardown races
@@ -903,13 +1002,15 @@ class AMQPConnection:
 
     async def _dispatch(self, command: AMQCommand) -> None:
         method = command.method
-        if self._remote_pending and type(method) is not am.Basic.Publish:
+        if (self._remote_pending or self._remote_chain is not None) \
+                and type(method) is not am.Basic.Publish:
             # any non-publish command may issue an inline remote RPC
             # (basic.get, queue purge/delete/stats, consume) or observe
-            # owner-side state: drain the pipelined publishes first so
-            # in-channel ordering holds (a get right after a publish must
-            # see the publish). Publishes keep buffering — _on_publish
-            # handles its own mandatory/immediate drain.
+            # owner-side state: drain the pipelined publishes first —
+            # buffered AND chained — so in-channel ordering holds (a get
+            # right after a publish must see the publish). Publishes keep
+            # buffering — _on_publish handles its own mandatory/immediate
+            # drain.
             await self._drain_remote()
         if command.channel in self._closing_channels:
             # discard everything pipelined behind our Channel.Close until the
@@ -1450,7 +1551,8 @@ class AMQPConnection:
             self.broker.account_memory(len(command.body))
             return
         method = command.method
-        if (method.mandatory or method.immediate) and self._remote_pending:
+        if (method.mandatory or method.immediate) and (
+                self._remote_pending or self._remote_chain is not None):
             # a mandatory/immediate publish awaits its remote push inline:
             # drain the buffered pipeline first so per-queue FIFO holds
             await self._drain_remote()
@@ -1492,10 +1594,13 @@ class AMQPConnection:
                     ErrorCode.NOT_IMPLEMENTED,
                     "exclusive consumers on remotely-owned queues",
                     method.CLASS_ID, method.METHOD_ID)
-            credit = channel.prefetch_count_consumer or channel.prefetch_count_global or 0
-            from ..cluster.node import DEFAULT_CREDIT
-
-            credit = min(credit, DEFAULT_CREDIT) if credit else DEFAULT_CREDIT
+            # credit window: the client's prefetch if it set one, else the
+            # cluster's pipelined consume window
+            # (chana.mq.cluster.consume-credit)
+            prefetch = (channel.prefetch_count_consumer
+                        or channel.prefetch_count_global or 0)
+            credit = min(prefetch, self.broker.cluster.consume_credit) \
+                if prefetch else self.broker.cluster.consume_credit
             await self.broker.cluster.remote_consume(
                 channel, self.vhost_name, method.queue, tag,
                 method.no_ack, credit, priority=int(x_priority or 0))
@@ -1696,7 +1801,8 @@ class AMQPConnection:
                     pub = op[1]
                     method = pub.method
                     if ((method.mandatory or method.immediate)
-                            and self._remote_pending):
+                            and (self._remote_pending
+                                 or self._remote_chain is not None)):
                         # same guard as _on_publish: a mandatory/immediate
                         # publish awaits its remote push inline, so drain
                         # the buffered pipeline first to keep per-queue FIFO

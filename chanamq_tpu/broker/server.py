@@ -328,6 +328,15 @@ async def run_node(config) -> None:
                     "chana.mq.replicate.batch-max"),
                 replicate_ack_timeout_ms=config.int(
                     "chana.mq.replicate.ack-timeout-ms"),
+                streams=config.int("chana.mq.cluster.streams"),
+                stream_inflight=config.int("chana.mq.cluster.stream-inflight"),
+                flush_window_us=config.int("chana.mq.cluster.flush-window-us"),
+                flush_max_bytes=config.size_bytes(
+                    "chana.mq.cluster.flush-max-bytes") or (1 << 20),
+                flush_max_count=config.int("chana.mq.cluster.flush-max-count"),
+                consume_credit=config.int("chana.mq.cluster.consume-credit"),
+                call_timeout_s=config.duration_s(
+                    "chana.mq.cluster.call-timeout") or 10.0,
             )
             await cluster.start()
         if stop_event.is_set():

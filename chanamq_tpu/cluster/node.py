@@ -33,6 +33,8 @@ from typing import TYPE_CHECKING, Any, Optional
 
 from ..amqp.properties import BasicProperties
 from ..replicate import ReplicationManager
+from . import dataplane as dp
+from .dataplane import PeerDataPlane
 from .hashring import HashRing
 from .membership import Member, Membership
 from .rpc import RpcError, RpcServer
@@ -45,6 +47,26 @@ if TYPE_CHECKING:  # pragma: no cover
 log = logging.getLogger("chanamq.cluster")
 
 DEFAULT_CREDIT = 200
+# remote-consume prefetch window (chana.mq.cluster.consume-credit): sized so
+# deliveries stream ahead of the settle round trip instead of stalling on it
+DEFAULT_CONSUME_CREDIT = 1024
+
+# decoded-properties memo for the binary push handler: publishers stream
+# identical header payloads, so the owner decodes each distinct one once
+# (same idea as the origin connection's _HEADER_CACHE)
+_PROPS_MEMO: dict[bytes, BasicProperties] = {}
+_PROPS_MEMO_MAX = 1024
+
+
+def _props_memo(props_raw) -> BasicProperties:
+    key = bytes(props_raw)
+    props = _PROPS_MEMO.get(key)
+    if props is None:
+        _, _, props = BasicProperties.decode_header(key)
+        if len(_PROPS_MEMO) >= _PROPS_MEMO_MAX:
+            _PROPS_MEMO.clear()
+        _PROPS_MEMO[key] = props
+    return props
 
 
 class ClusterNode:
@@ -64,6 +86,13 @@ class ClusterNode:
         replicate_sync: bool = False,
         replicate_batch_max: int = 256,
         replicate_ack_timeout_ms: int = 1000,
+        streams: int = 2,
+        stream_inflight: int = 32,
+        flush_window_us: int = 200,
+        flush_max_bytes: int = 1 << 20,
+        flush_max_count: int = 512,
+        consume_credit: int = DEFAULT_CONSUME_CREDIT,
+        call_timeout_s: float = 10.0,
     ) -> None:
         self.broker = broker
         self.rpc = RpcServer(host, port)
@@ -75,12 +104,26 @@ class ClusterNode:
         self.ring = HashRing([], virtual_nodes)
         # replicated queue-meta registry: (vhost, name) -> meta dict
         self.queue_metas: dict[tuple[str, str], dict] = {}
+        # owner-side (vhost, name) -> activated local Queue, for the binary
+        # push handler's per-record resolution. Cleared alongside the
+        # broker's route caches (broker.invalidate_routes) on any queue /
+        # holder / membership mutation.
+        self.resolve_cache: dict[tuple[str, str], Any] = {}
         # origin-side registry of remote consumers for failover re-register:
         # (vhost, queue, tag) -> info
         self._remote_consumers: dict[tuple[str, str, str], dict] = {}
-        # per-tick settle coalescing: (owner, vhost, queue, op, tag) ->
-        # [offsets, credit] flushed as one queue.settle RPC each
-        self._settle_buf: dict[tuple, list] = {}
+        # data-plane fast path (chana.mq.cluster.streams / flush-window-us /
+        # flush-max-*): binary batched pushes, settles, and deliveries
+        self._dataplanes: dict[str, PeerDataPlane] = {}
+        self._dp_streams = max(1, streams)
+        self._dp_inflight = max(1, stream_inflight)
+        self._dp_flush_window_us = flush_window_us
+        self._dp_flush_max_bytes = flush_max_bytes
+        self._dp_flush_max_count = flush_max_count
+        self.consume_credit = max(1, consume_credit)
+        # default per-call ask window for control RPCs (individual calls
+        # may still override — e.g. the 5 s snapshot pull at boot)
+        self.call_timeout_s = call_timeout_s
         self.name: str = ""
         broker.cluster = self
         self._register_handlers()
@@ -133,6 +176,9 @@ class ClusterNode:
             log.warning("%s: worker-id lease failed; keeping local id", self.name)
 
     async def stop(self) -> None:
+        dataplanes, self._dataplanes = self._dataplanes, {}
+        for plane in dataplanes.values():
+            await plane.close()
         if self.membership is not None:
             await self.membership.stop()
         await self.rpc.stop()
@@ -176,6 +222,7 @@ class ClusterNode:
         return not self.owns_queue(vhost, name)
 
     def _deactivate_unowned(self, boot: bool = False) -> None:
+        self.broker.invalidate_routes()
         for vhost in self.broker.vhosts.values():
             for name in list(vhost.queues):
                 queue = vhost.queues[name]
@@ -262,6 +309,7 @@ class ClusterNode:
     def _register_meta(self, queue: "Queue") -> None:
         # registering a live local queue claims holdership: ops for it must
         # come to this node while it serves consumers/messages
+        self.broker.invalidate_routes()
         self.queue_metas[(queue.vhost, queue.name)] = {
             "durable": queue.durable,
             "auto_delete": queue.auto_delete,
@@ -273,6 +321,7 @@ class ClusterNode:
     def _set_holder(self, vhost: str, name: str, holder: Optional[str]) -> None:
         """Record + replicate who serves a queue (None = released: the
         hash ring decides again)."""
+        self.broker.invalidate_routes()
         meta = self.queue_metas.get((vhost, name))
         if meta is not None:
             meta["holder"] = holder
@@ -297,7 +346,14 @@ class ClusterNode:
 
     def _on_membership_event(self, event: str, member: Member) -> None:
         assert self.membership is not None
+        self.broker.invalidate_routes()
         self.ring.set_nodes(self.membership.alive_members())
+        if event == "down":
+            # tear down the dead peer's data streams: buffered batches fail
+            # fast instead of dialing a corpse until their timeouts
+            plane = self._dataplanes.pop(member.name, None)
+            if plane is not None:
+                asyncio.get_event_loop().create_task(plane.close())
         if event == "down":
             # a dead node can't serve anything: clear its holderships so
             # queue_owner falls back to the ring (node names embed ephemeral
@@ -378,14 +434,37 @@ class ClusterNode:
     # RPC plumbing
     # ------------------------------------------------------------------
 
-    async def _call(self, node: str, method: str, payload: dict) -> dict:
+    async def _call(
+        self, node: str, method: str, payload: dict,
+        timeout_s: Optional[float] = None,
+    ) -> dict:
         assert self.membership is not None
-        if self._settle_buf and method != "queue.settle":
-            # buffered settles precede any subsequent RPC: a cancel /
-            # delete / purge issued after an ack in the same read batch
-            # must find the ack applied on the owner
-            await self._drain_settles()
-        return await self.membership.client(node).call(method, payload)
+        # buffered/in-flight settles precede any control RPC: a cancel /
+        # delete / purge issued after an ack in the same read batch must
+        # find the ack applied on the owner (the data and control planes
+        # are separate connections, so this fence is the only ordering)
+        await self._drain_settles()
+        return await self.membership.client(node).call(
+            method, payload, timeout_s=timeout_s or self.call_timeout_s)
+
+    def dataplane(self, node: str) -> PeerDataPlane:
+        """The binary fast path toward a peer (lazily dialed, N streams)."""
+        plane = self._dataplanes.get(node)
+        if plane is None or plane.closed:
+            member = (self.membership.members.get(node)
+                      if self.membership is not None else None)
+            host, port = (member.host, member.port) if member is not None \
+                else (node.rsplit(":", 1)[0], int(node.rsplit(":", 1)[1]))
+            plane = PeerDataPlane(
+                host, port,
+                streams=self._dp_streams,
+                inflight_per_stream=self._dp_inflight,
+                flush_window_us=self._dp_flush_window_us,
+                flush_max_bytes=self._dp_flush_max_bytes,
+                flush_max_count=self._dp_flush_max_count,
+                metrics=self.broker.metrics)
+            self._dataplanes[node] = plane
+        return plane
 
     async def _event(self, node: str, method: str, payload: dict) -> None:
         """Fire-and-forget event toward a peer. Loss is part of the design
@@ -427,6 +506,10 @@ class ClusterNode:
         rpc.register("consumer.deliver_many", self._h_consumer_deliver_many)
         rpc.register("consumer.credit", self._h_consumer_credit)
         rpc.register("consumer.cancelled", self._h_consumer_cancelled)
+        # data plane: binary zero-copy bodies, no field-table codec
+        rpc.register_binary(dp.METHOD_PUSH_MANY, self._hb_push_many)
+        rpc.register_binary(dp.METHOD_SETTLE_MANY, self._hb_settle_many)
+        rpc.register_binary(dp.METHOD_DELIVER_MANY, self._hb_deliver_many)
 
     # ------------------------------------------------------------------
     # metadata replication
@@ -468,6 +551,7 @@ class ClusterNode:
         return self._snapshot()
 
     async def _apply_snapshot(self, snapshot: dict) -> None:
+        self.broker.invalidate_routes()
         for vhost_name, active in (snapshot.get("vhosts") or {}).items():
             if vhost_name not in self.broker.vhosts:
                 await self.broker.create_vhost(vhost_name)
@@ -479,7 +563,10 @@ class ClusterNode:
             self.queue_metas[(vhost, name)] = dict(meta)
 
     async def _h_meta_apply(self, payload: dict) -> dict:
-        """Apply one replicated metadata mutation (broadcast receiver)."""
+        """Apply one replicated metadata mutation (broadcast receiver).
+        Every kind mutates routing inputs (queue metas, holders, bindings,
+        exchanges), so cached publish routes drop first."""
+        self.broker.invalidate_routes()
         kind = str(payload.get("kind"))
         vhost_name = str(payload.get("vhost", ""))
         if kind == "vhost.created":
@@ -710,6 +797,119 @@ class ClusterNode:
                 await self.replication.sync_barrier()
         return {"ok": True}
 
+    # ------------------------------------------------------------------
+    # data-plane handlers (binary fast path; see cluster/dataplane.py)
+    # ------------------------------------------------------------------
+
+    async def _hb_push_many(self, view: memoryview) -> None:
+        """Binary queue.push_many: bodies and property headers land as
+        memoryview slices of the RPC read buffer and go into Message.body
+        uncopied. Same partial-failure contract as the table handler: a
+        missing/deleted queue skips ITS push, the rest of the batch lands;
+        one store flush group-commits every persistent push. The reply
+        releases the origin's confirm barrier. Per-record hot path:
+        resolved queues and decoded property headers memoize (origins
+        re-send identical routes and props for streams of publishes)."""
+        self.broker.metrics.rpc_data_bytes_recv += len(view)
+        marks: list[tuple[int, int]] = []
+        any_persisted = False
+        rcache = self.resolve_cache
+        for vhost, names, exchange, routing_key, props_raw, body in \
+                dp.decode_push_many(view):
+            queues = []
+            for name in names:
+                queue = rcache.get((vhost, name))
+                if queue is None:
+                    # slow path activates from the store; misses (unknown
+                    # queue) stay uncached so a later declare is seen
+                    queue = await self.broker.activate_queue(vhost, name)
+                    if queue is None:
+                        continue
+                    rcache[(vhost, name)] = queue
+                queues.append(queue)
+            if not queues:
+                continue
+            props = _props_memo(props_raw)
+            message = self.broker.push_local(
+                queues, props, body, exchange, routing_key, props_raw, marks)
+            any_persisted = any_persisted or message.persisted
+        if any_persisted:
+            await self.broker.store.flush(marks)
+            if self.replication is not None and self.replication.sync:
+                await self.replication.sync_barrier()
+        return None
+
+    async def _hb_settle_many(self, view: memoryview) -> None:
+        """Binary queue.settle_many: one frame settles offsets across any
+        number of (queue, op, tag) groups coalesced inside the origin's
+        flush window. Application order follows frame order, so an ack
+        buffered before a requeue of the same consumer applies first."""
+        self.broker.metrics.rpc_data_bytes_recv += len(view)
+        for vhost_name, queue_name, op, tag, credit, offsets in \
+                dp.decode_settle_many(view):
+            vhost = self.broker.vhosts.get(vhost_name)
+            queue = vhost.queues.get(queue_name) if vhost else None
+            if queue is None:
+                continue
+            for offset in offsets:
+                delivery = queue.outstanding.get(offset)
+                if delivery is None:
+                    continue
+                if op == "ack":
+                    queue.ack(delivery)
+                elif op == "drop":
+                    queue.drop(delivery)
+                else:
+                    queue.requeue(delivery)
+            if tag and credit:
+                for consumer in queue.consumers:
+                    if isinstance(consumer, RemoteConsumer) \
+                            and consumer.tag == tag:
+                        consumer.credit += credit
+                        for offset in offsets:
+                            consumer.outstanding_offsets.discard(offset)
+            queue.schedule_dispatch()
+        return None
+
+    async def _hb_deliver_many(self, view: memoryview) -> None:
+        """Binary consumer.deliver_many (origin side): every record renders
+        to the client synchronously BEFORE any await, so two pipelined
+        batches for one consumer can never interleave; credit replenishes
+        once per batch."""
+        self.broker.metrics.rpc_data_bytes_recv += len(view)
+        vhost, queue, tag, records = dp.decode_deliver_many(view)
+        key = (vhost, queue, tag)
+        info = self._remote_consumers.get(key)
+        if info is None:
+            return None
+        stub = info["stub"]
+        channel: "ServerChannel" = info["channel"]
+        if channel.closed:
+            return None
+        from ..broker.entities import Message, QueuedMessage
+
+        applied = 0
+        for (offset, redelivered, msg_id, expire_at_ms, exchange,
+                routing_key, props_raw, body) in records:
+            props = _props_memo(props_raw)
+            message = Message(
+                msg_id, props, body, exchange, routing_key,
+                header_raw=props_raw)
+            qm = QueuedMessage(message, offset, expire_at_ms)
+            qm.redelivered = redelivered
+            channel.deliver(stub, stub.queue, qm)
+            applied += 1
+        if info["no_ack"] and applied:
+            # replenish credit as we render (owner decremented on send)
+            info["pending_credit"] = info.get("pending_credit", 0) + applied
+            if info["pending_credit"] >= 32:
+                credit = info["pending_credit"]
+                info["pending_credit"] = 0
+                await self._event(info["owner"], "consumer.credit", {
+                    "vhost": vhost, "queue": queue, "tag": tag,
+                    "credit": credit})
+        return None
+
     async def _h_queue_get(self, payload: dict) -> dict:
         queue = await self._local_queue(str(payload["vhost"]), str(payload["queue"]))
         qm = await queue.basic_get()
@@ -897,22 +1097,38 @@ class ClusterNode:
         reply = await self._call(owner, "queue.stats", {"vhost": vhost, "name": name})
         return int(reply.get("message_count", 0)), int(reply.get("consumer_count", 0))
 
-    async def push_batch(self, records: list) -> list[BaseException]:
-        """Send one queue.push_many RPC per owner covering a read batch of
-        pipelined publishes (records: (owner, push-payload) in publish
-        order). Returns RPC failures instead of raising — the caller's
-        barrier decides strictness (confirm mode: connection error;
-        best-effort: logged)."""
-        by_owner: dict[str, list[dict]] = {}
+    def submit_batch(self, records: list) -> set[asyncio.Future]:
+        """Submit a read batch of pipelined publishes to the data plane
+        (records: (owner, (vhost, queues, exchange, routing_key, props_raw,
+        body)) in publish order) and demand-flush the covering micro-
+        batches onto their streams. Synchronous: the RPCs are on the wire
+        (or queued behind a stream window) when this returns, so callers
+        can keep submitting later batches while earlier ones fly. Bodies
+        ride by reference into the binary frames — no copies."""
+        futures: set[asyncio.Future] = set()
+        planes: dict[str, PeerDataPlane] = {}
         for owner, rec in records:
-            by_owner.setdefault(owner, []).append(rec)
-        tasks = [
-            asyncio.ensure_future(
-                self._call(owner, "queue.push_many", {"pushes": recs}))
-            for owner, recs in by_owner.items()
-        ]
-        results = await asyncio.gather(*tasks, return_exceptions=True)
+            plane = planes.get(owner)
+            if plane is None:
+                planes[owner] = plane = self.dataplane(owner)
+            futures.add(plane.submit_push(*rec))
+        # demand-flush: this caller's barrier must not wait out the window
+        # timer (other connections' pushes may still coalesce in behind)
+        for plane in planes.values():
+            plane.flush_all(demand=True)
+        return futures
+
+    @staticmethod
+    async def await_batch(futures: set[asyncio.Future]) -> list[BaseException]:
+        """Barrier on submit_batch futures. Returns failures instead of
+        raising — the caller's barrier decides strictness (confirm mode:
+        connection error; best-effort: logged)."""
+        results = await asyncio.gather(*futures, return_exceptions=True)
         return [r for r in results if isinstance(r, BaseException)]
+
+    async def push_batch(self, records: list) -> list[BaseException]:
+        """submit_batch + await_batch in one step (synchronous callers)."""
+        return await self.await_batch(self.submit_batch(records))
 
     async def remote_push(
         self, owner: str, vhost: str, queues: list[str], props_raw: bytes,
@@ -933,8 +1149,11 @@ class ClusterNode:
 
     async def remote_consume(
         self, channel: "ServerChannel", vhost: str, name: str, tag: str,
-        no_ack: bool, credit: int = DEFAULT_CREDIT, priority: int = 0,
+        no_ack: bool, credit: int = 0, priority: int = 0,
     ) -> "RemoteQueueRef":
+        # default window: chana.mq.cluster.consume-credit — sized so
+        # pipelined deliveries stream ahead of the settle round trip
+        credit = credit or self.consume_credit
         owner = self.queue_owner(vhost, name)
         ref = RemoteQueueRef(self, vhost, name)
         from ..broker.channel import Consumer
@@ -996,46 +1215,23 @@ class ClusterNode:
     def settle_bg(self, vhost: str, name: str, op: str, offsets: list[int],
                   tag: str = "", credit: int = 0) -> None:
         """Fire-and-forget settle (ack/drop/requeue) toward the queue
-        owner. Settles coalesce per (owner, queue, op, tag) within one
-        loop tick — a consumer acking a whole read batch costs one RPC,
-        not one per message; the owner's queue.settle handler already
-        takes offset lists."""
+        owner via the data plane. Settles coalesce per (owner, queue, op,
+        tag) inside the peer's flush window — a consumer acking a whole
+        read batch (or several consumers across channels) costs one binary
+        settle_many frame, not one RPC per message."""
         owner = self.queue_owner(vhost, name)
-        key = (owner, vhost, name, op, tag)
-        if not self._settle_buf:  # first settle this tick: schedule flush
-            asyncio.get_event_loop().call_soon(self._flush_settles)
-        entry = self._settle_buf.get(key)
-        if entry is None:
-            self._settle_buf[key] = entry = [[], 0]
-        entry[0].extend(offsets)
-        entry[1] += credit
-
-    def _flush_settles(self) -> None:
-        # the buffer is swapped only inside _drain_settles, at task
-        # EXECUTION time: any competing RPC whose task runs before the
-        # drain task still sees a full buffer and drains inline first
-        # (_call), so settle-before-X order holds in every interleaving
-        if self._settle_buf:
-            asyncio.get_event_loop().create_task(self._drain_settles())
+        self.dataplane(owner).submit_settle(
+            vhost, name, op, offsets, tag, credit)
 
     async def _drain_settles(self) -> None:
-        """Send buffered settles NOW, inline — called before any other
-        outbound RPC so a settle enqueued first reaches the owner first
-        (e.g. ack-then-cancel in one read batch must not requeue the acked
-        message; _call invokes this, and _settle_one's own _call finds the
-        buffer already empty)."""
-        buf, self._settle_buf = self._settle_buf, {}
-        for (owner, vhost, name, op, tag), (offsets, credit) in buf.items():
-            await self._settle_one(owner, vhost, name, op, tag, offsets, credit)
-
-    async def _settle_one(self, owner: str, vhost: str, name: str, op: str,
-                          tag: str, offsets: list[int], credit: int) -> None:
-        try:
-            await self._call(owner, "queue.settle", {
-                "vhost": vhost, "queue": name, "op": op,
-                "offsets": offsets, "tag": tag, "credit": credit})
-        except (RpcError, OSError) as exc:
-            log.warning("settle %s %s failed: %s", op, offsets, exc)
+        """Flush + await every in-flight settle batch on every peer — the
+        data/control-plane ordering fence. The planes ride separate
+        connections from the control RPCs, so a settle enqueued before a
+        cancel / delete / purge is only guaranteed applied on the owner
+        because _call awaits this first (ack-then-cancel in one read batch
+        must not requeue the acked message)."""
+        for plane in list(self._dataplanes.values()):
+            await plane.drain_settles()
 
 
 class RemoteConsumer:
@@ -1044,7 +1240,7 @@ class RemoteConsumer:
 
     __slots__ = ("cluster", "tag", "queue", "no_ack", "origin", "credit",
                  "exclusive", "priority", "outstanding_offsets", "_buf",
-                 "_flush_scheduled")
+                 "_buf_count", "_flush_scheduled")
 
     def __init__(self, cluster: ClusterNode, tag: str, queue: "Queue",
                  no_ack: bool, origin: str, credit: int,
@@ -1061,9 +1257,10 @@ class RemoteConsumer:
         self.exclusive = False
         self.outstanding_offsets: set[int] = set()
         # per-tick delivery coalescing: every deliver() of one dispatch
-        # pass rides a single consumer.deliver_many event (same pattern as
-        # the store's group-commit kick)
-        self._buf: list[dict] = []
+        # pass rides a single binary deliver_many event (same pattern as
+        # the store's group-commit kick); flat [meta, body, ...] buffers
+        self._buf: list = []
+        self._buf_count = 0
         self._flush_scheduled = False
 
     def can_take(self, next_size: int) -> bool:
@@ -1077,13 +1274,12 @@ class RemoteConsumer:
 
         self.credit -= 1
         msg = qm.message
-        self._buf.append({
-            "offset": qm.offset, "redelivered": qm.redelivered,
-            "exchange": msg.exchange, "routing_key": msg.routing_key,
-            "props_raw": msg.header_payload(),
-            "body": msg.body, "msg_id": msg.id,
-            "expire_at_ms": qm.expire_at_ms,
-        })
+        # encode inline: two small buffers per record (meta + body-by-ref),
+        # the body is never copied between the queue and the socket
+        self._buf.extend(dp.encode_deliver_record(
+            qm.offset, qm.redelivered, msg.id, qm.expire_at_ms,
+            msg.exchange, msg.routing_key, msg.header_payload(), msg.body))
+        self._buf_count += 1
         if not self._flush_scheduled:
             self._flush_scheduled = True
             asyncio.get_event_loop().call_soon(self._flush)
@@ -1097,33 +1293,33 @@ class RemoteConsumer:
     _FLUSH_BYTES = 8 * 1024 * 1024
 
     def _flush(self) -> None:
+        """Ship the coalesced dispatch pass as binary deliver_many events
+        (one per size-capped chunk, all striped onto the same data stream
+        so they render in order on the origin)."""
         self._flush_scheduled = False
         if not self._buf:
             return
-        deliveries, self._buf = self._buf, []
-        loop = asyncio.get_event_loop()
-        chunk: list[dict] = []
+        records, self._buf = self._buf, []
+        count, self._buf_count = self._buf_count, 0
+        plane = self.cluster.dataplane(self.origin)
+        chunk: list = []
+        chunk_count = 0
         size = 0
-        for delivery in deliveries:
-            chunk.append(delivery)
-            size += len(delivery["body"]) + len(delivery["props_raw"]) + 128
+        # records is a flat [meta, body, meta, body, ...] buffer list
+        for i in range(0, len(records), 2):
+            chunk.append(records[i])
+            chunk.append(records[i + 1])
+            chunk_count += 1
+            size += len(records[i]) + len(records[i + 1])
             if size >= self._FLUSH_BYTES:
-                self._send_chunk(loop, chunk)
-                chunk, size = [], 0
+                plane.send_deliver_many(
+                    self.queue.vhost, self.queue.name, self.tag,
+                    chunk, chunk_count)
+                chunk, chunk_count, size = [], 0, 0
         if chunk:
-            self._send_chunk(loop, chunk)
-
-    def _send_chunk(self, loop, deliveries: list[dict]) -> None:
-        # NOTE: consumer.deliver_many is part of the intra-cluster RPC
-        # protocol, which assumes all nodes run the same build (the
-        # reference's Akka remoting carries the same constraint); the
-        # single-delivery consumer.deliver handler remains served for
-        # completeness but is no longer sent
-        loop.create_task(
-            self.cluster._event(self.origin, "consumer.deliver_many", {
-                "vhost": self.queue.vhost, "queue": self.queue.name,
-                "tag": self.tag, "deliveries": deliveries,
-            }))
+            plane.send_deliver_many(
+                self.queue.vhost, self.queue.name, self.tag,
+                chunk, chunk_count)
 
     def detach(self) -> None:
         """The owner's queue died under this remote consumer: tell the

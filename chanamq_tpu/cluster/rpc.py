@@ -10,6 +10,15 @@ cluster layer introduces no second serialization scheme and no pickle.
 Frame: u32 body-length | u64 correlation-id | u8 kind | shortstr method |
        table payload
 kinds: 0=request 1=response 2=error 3=event (fire-and-forget)
+
+Data-plane frames (cluster/dataplane.py) share the listener but skip the
+field-table codec entirely — after the common head comes a u8 method id and
+a method-specific binary payload whose bulk fields (message bodies, property
+headers) are length-prefixed raw bytes, decoded as memoryview slices of the
+read buffer (no copy):
+
+       u32 body-length | u64 correlation-id | u8 kind | u8 method-id | raw
+kinds: 4=data-request 5=data-response 6=data-event
 """
 
 from __future__ import annotations
@@ -18,7 +27,7 @@ import asyncio
 import logging
 import struct
 from io import BytesIO
-from typing import Awaitable, Callable, Optional
+from typing import Awaitable, Callable, Optional, Union
 
 from ..amqp import value_codec as vc
 
@@ -28,11 +37,18 @@ KIND_REQUEST = 0
 KIND_RESPONSE = 1
 KIND_ERROR = 2
 KIND_EVENT = 3
+# binary fast-path kinds (cluster/dataplane.py): payload is raw bytes after
+# a u8 method id, never a field table
+KIND_DREQUEST = 4
+KIND_DRESPONSE = 5
+KIND_DEVENT = 6
 
 _HEAD = struct.Struct(">IQB")
 MAX_FRAME = 64 * 1024 * 1024
 
 Handler = Callable[[dict], Awaitable[Optional[dict]]]
+# binary handler: memoryview payload -> response payload parts (None = ok)
+BinaryHandler = Callable[[memoryview], Awaitable[Optional[list]]]
 
 
 class RpcError(Exception):
@@ -55,17 +71,50 @@ def _encode(corr_id: int, kind: int, method: str, payload: dict) -> bytes:
     return _HEAD.pack(len(data) + 9, corr_id, kind) + data
 
 
-async def _read_frame(reader: asyncio.StreamReader) -> tuple[int, int, str, dict]:
+def encode_data_frame(
+    corr_id: int, kind: int, method_id: int, parts: list,
+) -> list:
+    """Binary frame as a buffer list for writer.writelines: one packed head
+    (+ method id) followed by the caller's payload parts verbatim — bodies
+    and property blobs are never copied into a joined frame."""
+    payload_len = sum(len(p) for p in parts)
+    head = bytearray(_HEAD.pack(payload_len + 10, corr_id, kind))
+    head.append(method_id)
+    return [bytes(head), *parts]
+
+
+async def _read_frame(
+    reader: asyncio.StreamReader,
+) -> tuple[int, int, Union[str, int], Union[dict, memoryview]]:
+    """One frame off the wire. Table-coded kinds return (corr, kind,
+    method-name, payload-dict); data-plane kinds return (corr, kind,
+    method-id, payload-memoryview) — the view slices the read buffer, so
+    bulk fields inside it are zero-copy all the way to Message.body."""
     head = await reader.readexactly(4)
     (length,) = struct.unpack(">I", head)
     if length > MAX_FRAME:
-        raise RpcError("frame_too_large", f"{length} bytes")
+        # the oversized body is still in the stream: the connection is
+        # desynced mid-frame and can only be dropped (callers close the
+        # transport and surface a reconnectable error)
+        raise FrameTooLarge(f"{length} bytes")
     body = await reader.readexactly(length)
     corr_id, kind = struct.unpack_from(">QB", body)
+    if kind >= KIND_DREQUEST:
+        view = memoryview(body)
+        return corr_id, kind, view[9], view[10:]
     stream = BytesIO(body[9:])
     method = vc.read_shortstr(stream)
     payload = vc.read_table(stream)
     return corr_id, kind, method, payload
+
+
+class FrameTooLarge(RpcError):
+    """A peer announced a frame beyond MAX_FRAME: past this point the byte
+    stream cannot be re-synchronized, so the connection must be closed and
+    re-established (reconnectable, not a protocol-level reply)."""
+
+    def __init__(self, detail: str) -> None:
+        super().__init__("frame_too_large", detail)
 
 
 class RpcServer:
@@ -75,11 +124,17 @@ class RpcServer:
         self.host = host
         self.port = port
         self.handlers: dict[str, Handler] = {}
+        self.binary_handlers: dict[int, BinaryHandler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._peer_writers: set[asyncio.StreamWriter] = set()
 
     def register(self, method: str, handler: Handler) -> None:
         self.handlers[method] = handler
+
+    def register_binary(self, method_id: int, handler: BinaryHandler) -> None:
+        """Data-plane handler: receives the raw payload view; its return
+        (a buffer list, or None for a bare ok) rides a KIND_DRESPONSE."""
+        self.binary_handlers[method_id] = handler
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._on_client, self.host, self.port)
@@ -116,12 +171,27 @@ class RpcServer:
                         asyncio.get_event_loop().create_task(
                             self._run_event(handler, method, payload))
                     continue
+                if kind == KIND_DEVENT:
+                    bhandler = self.binary_handlers.get(method)
+                    if bhandler is not None:
+                        asyncio.get_event_loop().create_task(
+                            self._run_binary_event(bhandler, method, payload))
+                    continue
+                if kind == KIND_DREQUEST:
+                    asyncio.get_event_loop().create_task(
+                        self._run_binary_request(
+                            writer, corr_id, method, payload))
+                    continue
                 if kind != KIND_REQUEST:
                     continue
                 asyncio.get_event_loop().create_task(
                     self._run_request(writer, corr_id, method, payload))
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
+        except FrameTooLarge as exc:
+            # desynced mid-stream: drop the connection (the peer's client
+            # reconnects); replying in-band is impossible past this point
+            log.warning("rpc server closing desynced peer connection: %s", exc)
         except Exception:
             log.exception("rpc server connection failed")
         finally:
@@ -159,29 +229,111 @@ class RpcServer:
         except (ConnectionResetError, BrokenPipeError):
             pass
 
+    async def _run_binary_event(
+        self, handler: BinaryHandler, method_id: int, payload: memoryview
+    ) -> None:
+        try:
+            await handler(payload)
+        except Exception:
+            log.exception("rpc binary event handler %d failed", method_id)
+
+    async def _run_binary_request(
+        self, writer: asyncio.StreamWriter, corr_id: int, method_id: int,
+        payload: memoryview,
+    ) -> None:
+        """Serve one data-plane request; the reply is a status byte (0=ok)
+        plus any handler payload parts, or 1 + shortstr error text."""
+        handler = self.binary_handlers.get(method_id)
+        try:
+            if handler is None:
+                raise RpcError("no_such_method", f"binary method {method_id}")
+            result = await handler(payload)
+            parts = [b"\x00", *(result or [])]
+        except Exception as exc:
+            if not isinstance(exc, RpcError):
+                log.exception("rpc binary handler %d failed", method_id)
+            text = str(exc).encode("utf-8", "replace")[:255]
+            parts = [b"\x01", bytes((len(text),)), text]
+        try:
+            writer.writelines(
+                encode_data_frame(corr_id, KIND_DRESPONSE, method_id, parts))
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+
+
+class ReconnectBackoff:
+    """Exponential backoff shared by the control and data clients: after a
+    failed connect, further attempts fail IMMEDIATELY until the deadline so
+    a dead peer costs callers one fast exception, not a connect timeout
+    each (satellite of the stacked interconnect PR). Success resets it."""
+
+    __slots__ = ("base_s", "max_s", "_delay_s", "_retry_at")
+
+    def __init__(self, base_s: float = 0.1, max_s: float = 5.0) -> None:
+        self.base_s = base_s
+        self.max_s = max_s
+        self._delay_s = 0.0
+        self._retry_at = 0.0
+
+    def check(self) -> None:
+        if self._delay_s and asyncio.get_event_loop().time() < self._retry_at:
+            raise RpcError(
+                "backoff", f"reconnect suppressed for {self._delay_s:.1f}s")
+
+    def failed(self) -> None:
+        self._delay_s = min(
+            self.max_s, (self._delay_s * 2) if self._delay_s else self.base_s)
+        self._retry_at = asyncio.get_event_loop().time() + self._delay_s
+
+    def succeeded(self) -> None:
+        self._delay_s = 0.0
+
 
 class RpcClient:
     """One outgoing connection to a peer, with correlation-id matching.
-    Reconnects lazily on next call after a drop."""
+    Reconnects lazily on next call after a drop, with exponential backoff
+    after a failed connect (a dead peer fails callers fast instead of
+    stalling each for the full ask window)."""
 
-    def __init__(self, host: str, port: int, *, timeout_s: float = 20.0) -> None:
+    def __init__(
+        self, host: str, port: int, *, timeout_s: float = 20.0,
+        connect_timeout_s: float = 3.0,
+    ) -> None:
         self.host = host
         self.port = port
-        self.timeout_s = timeout_s  # the reference's 20 s internal ask timeout
+        # default ask window (the reference's 20 s internal ask timeout);
+        # every call() may override it per request
+        self.timeout_s = timeout_s
+        self.connect_timeout_s = connect_timeout_s
         self._writer: Optional[asyncio.StreamWriter] = None
         self._reader_task: Optional[asyncio.Task] = None
         self._waiters: dict[int, asyncio.Future] = {}
         self._next_corr = 1
         self._connect_lock = asyncio.Lock()
+        self._backoff = ReconnectBackoff()
         self.closed = False
 
     async def _ensure_connected(self) -> asyncio.StreamWriter:
         if self._writer is not None and not self._writer.is_closing():
             return self._writer
+        # outside the lock too: callers queued BEHIND a reconnect attempt
+        # fail fast once the holder's attempt has failed, instead of each
+        # retrying the dial serially
+        self._backoff.check()
         async with self._connect_lock:
             if self._writer is not None and not self._writer.is_closing():
                 return self._writer
-            reader, writer = await asyncio.open_connection(self.host, self.port)
+            self._backoff.check()
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(self.host, self.port),
+                    self.connect_timeout_s)
+            except BaseException:
+                self._backoff.failed()
+                # requests already queued on the lock see the fresh backoff
+                raise
+            self._backoff.succeeded()
             self._writer = writer
             self._reader_task = asyncio.get_event_loop().create_task(
                 self._read_loop(reader, writer))
@@ -204,6 +356,12 @@ class RpcClient:
                         str(payload.get("message", ""))))
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             pass
+        except FrameTooLarge as exc:
+            # mid-stream desync: close the transport (finally below) so the
+            # next call reconnects cleanly; in-flight waiters fail with a
+            # reconnectable error rather than the loop dying unobserved
+            log.warning("rpc client %s:%s desynced: %s; reconnecting",
+                        self.host, self.port, exc)
         finally:
             self._fail_waiters(RpcError("disconnected", f"{self.host}:{self.port}"))
             # close OUR writer (dead peer), not whatever reconnect may have
@@ -219,6 +377,9 @@ class RpcClient:
         for fut in self._waiters.values():
             if not fut.done():
                 fut.set_exception(exc)
+                # a cancelled/timed-out call may never await this waiter:
+                # mark the exception retrieved so teardown stays silent
+                fut.exception()
         self._waiters.clear()
 
     async def call(

@@ -108,6 +108,16 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.cluster.heartbeat-interval": "1s",
     "chana.mq.cluster.failure-timeout": "5s",
     "chana.mq.cluster.virtual-nodes": 64,
+    # interconnect data plane (cluster/dataplane.py): parallel binary
+    # streams per peer, per-stream pipelining window, and the adaptive
+    # micro-batch flush window (cut early by the byte/count caps)
+    "chana.mq.cluster.streams": 2,
+    "chana.mq.cluster.stream-inflight": 32,
+    "chana.mq.cluster.flush-window-us": 200,
+    "chana.mq.cluster.flush-max-bytes": "1MiB",
+    "chana.mq.cluster.flush-max-count": 512,
+    "chana.mq.cluster.consume-credit": 1024,
+    "chana.mq.cluster.call-timeout": "10s",
     # queue replication (replicate/): each queue's mutations are log-shipped
     # to factor-1 follower nodes which keep a warm passive copy; on owner
     # death the highest-synced follower promotes. factor=1 disables.

@@ -355,6 +355,32 @@ class AdminServer:
                     "lag_events": cluster.replication.total_lag(),
                     "copies": len(cluster.replication.applier.copies),
                 }),
+            "interconnect": self._interconnect(cluster),
+        }
+
+    def _interconnect(self, cluster) -> dict:
+        """Data-plane fast-path state: per-peer stream depth / buffered
+        micro-batches plus the global binary-frame counters."""
+        m = self.broker.metrics
+        return {
+            "peers": {
+                peer: plane.stats()
+                for peer, plane in cluster._dataplanes.items()
+            },
+            "data_bytes_sent": m.rpc_data_bytes_sent,
+            "data_bytes_recv": m.rpc_data_bytes_recv,
+            "push_records": m.rpc_push_records,
+            "push_batches": m.rpc_push_batches,
+            "settle_records": m.rpc_settle_records,
+            "settle_batches": m.rpc_settle_batches,
+            "deliver_records": m.rpc_deliver_records,
+            "deliver_batches": m.rpc_deliver_batches,
+            "flushes": {
+                "window": m.rpc_flush_window,
+                "bytes": m.rpc_flush_bytes,
+                "count": m.rpc_flush_count,
+                "demand": m.rpc_flush_demand,
+            },
         }
 
     def _replication(self) -> dict:

@@ -86,6 +86,21 @@ class Metrics:
         self.stream_segments_truncated = 0
         self.stream_records_delivered = 0
         self.stream_cursor_commits = 0
+        # cluster interconnect data plane (cluster/dataplane.py): binary
+        # frame volume, batch sizes, and what cut each batch (window timer,
+        # byte cap, count cap, or a barrier demanding an early flush)
+        self.rpc_data_bytes_sent = 0
+        self.rpc_data_bytes_recv = 0
+        self.rpc_push_records = 0
+        self.rpc_push_batches = 0
+        self.rpc_settle_records = 0
+        self.rpc_settle_batches = 0
+        self.rpc_deliver_records = 0
+        self.rpc_deliver_batches = 0
+        self.rpc_flush_window = 0
+        self.rpc_flush_bytes = 0
+        self.rpc_flush_count = 0
+        self.rpc_flush_demand = 0
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -130,4 +145,16 @@ class Metrics:
             "stream_segments_truncated": self.stream_segments_truncated,
             "stream_records_delivered": self.stream_records_delivered,
             "stream_cursor_commits": self.stream_cursor_commits,
+            "rpc_data_bytes_sent": self.rpc_data_bytes_sent,
+            "rpc_data_bytes_recv": self.rpc_data_bytes_recv,
+            "rpc_push_records": self.rpc_push_records,
+            "rpc_push_batches": self.rpc_push_batches,
+            "rpc_settle_records": self.rpc_settle_records,
+            "rpc_settle_batches": self.rpc_settle_batches,
+            "rpc_deliver_records": self.rpc_deliver_records,
+            "rpc_deliver_batches": self.rpc_deliver_batches,
+            "rpc_flush_window": self.rpc_flush_window,
+            "rpc_flush_bytes": self.rpc_flush_bytes,
+            "rpc_flush_count": self.rpc_flush_count,
+            "rpc_flush_demand": self.rpc_flush_demand,
         }

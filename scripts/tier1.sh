@@ -1,7 +1,9 @@
 #!/usr/bin/env bash
-# Tier-1 gate: the exact ROADMAP.md verify line, then a short stream-bench
-# smoke so the segmented-log dispatch path gets exercised end to end
-# (bench.py --stream: 1 producer, 3 cursors at first/next/timestamp).
+# Tier-1 gate: the exact ROADMAP.md verify line, then short bench smokes —
+# a 2-node cluster run so the binary interconnect (push_many / settle_many
+# / deliver_many over the data plane) gets exercised end to end, and a
+# stream run for the segmented-log dispatch path (bench.py --stream:
+# 1 producer, 3 cursors at first/next/timestamp).
 set -u
 cd "$(dirname "$0")/.."
 
@@ -10,6 +12,13 @@ if [ "$rc" -ne 0 ]; then
     echo "tier1: pytest FAILED (rc=$rc)" >&2
     exit "$rc"
 fi
+
+echo "tier1: 2-node cluster bench smoke (5 s)"
+BENCH_SECONDS=5 timeout -k 10 120 python bench.py --cluster || {
+    rc=$?
+    echo "tier1: cluster bench smoke FAILED (rc=$rc)" >&2
+    exit "$rc"
+}
 
 echo "tier1: stream bench smoke (5 s)"
 BENCH_SECONDS=5 timeout -k 10 120 python bench.py --stream || {
