@@ -751,6 +751,40 @@ def main() -> None:
         }))
         return
 
+    if "--chaos" in sys.argv:
+        # seeded chaos soak: the 2-node workload of chanamq_tpu/chaos/soak.py
+        # under the default fault plan (partition + owner crash + slow
+        # store). Same seed -> same plan fingerprint and fault schedule;
+        # any invariant violation exits non-zero so tier-1 gates on it.
+        seed = 42
+        if "--seed" in sys.argv:
+            seed = int(sys.argv[sys.argv.index("--seed") + 1])
+        messages = int(os.environ.get("CHAOS_MESSAGES", "160"))
+        from chanamq_tpu.chaos.soak import run_soak
+
+        try:
+            result = asyncio.run(asyncio.wait_for(
+                run_soak(seed, messages=messages), timeout=150))
+        except Exception as exc:
+            result = {"seed": seed,
+                      "violations": [f"{type(exc).__name__}: {exc}"]}
+        print(f"# chaos_soak: {result}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "chaos_soak_violations",
+            "value": len(result.get("violations", [])),
+            "unit": "violations",
+            "vs_baseline": None,
+            "seed": seed,
+            "fingerprint": result.get("fingerprint"),
+            "confirmed": result.get("confirmed"),
+            "duplicates": result.get("duplicates"),
+            "promotions": result.get("promotions"),
+            "chaos_soak": {k: v for k, v in result.items() if k != "chaos"},
+        }))
+        if result.get("violations"):
+            sys.exit(1)  # the tier-1 smoke must fail loudly
+        return
+
     if "--cluster" in sys.argv:
         # cluster scenario only: 2 in-process nodes, burst publish via the
         # non-owner + remote consume + paced remote latency — the

@@ -309,6 +309,14 @@ async def run_node(config) -> None:
         # would see a node that mis-routes clustered queues
         await server.start(listen=False)
         started = True
+        # chaos wiring before any traffic: wraps the store, marks the
+        # broker chaos-capable, optionally installs a boot plan. With
+        # chana.mq.chaos.enabled unset this is a single bool check and the
+        # seams stay no-op module-attribute loads.
+        if config.bool("chana.mq.chaos.enabled"):
+            from .. import chaos as chaos_mod
+
+            chaos_mod.enable_from_config(config, server.broker)
         if config.bool("chana.mq.cluster.enabled"):
             from ..cluster.node import ClusterNode
 

@@ -1,0 +1,393 @@
+"""The chaos soak: a 2-node workload under a seeded fault plan.
+
+Shared by ``bench.py --chaos`` and ``tests/test_chaos.py`` so the tier-1
+smoke and the test suite assert the same invariants:
+
+1. **No confirmed message lost** — every body whose publisher confirm
+   arrived is delivered to the consumer at least once.
+2. **No double-delivery after settle** — duplicates during failover are
+   at-least-once reality and merely counted; once the workload settles
+   (everything delivered, surviving owner's queue empty, observation
+   window passed) no further delivery may arrive.
+3. **Exactly one failover promotion** — the owner crash promotes exactly
+   one replica, cluster-wide.
+4. **Cursors resume at committed offsets** — a stream consumer that
+   detaches and reattaches at "next" resumes at committed+1 and reads
+   contiguously to the tail.
+5. **Reconnect stays inside the backoff budget** — the publisher finishes
+   every message despite injected disconnects/partitions, and no stream's
+   backoff delay ever exceeds the configured ceiling.
+
+Topology: nodes A and B with private MemoryStores, replicate factor 2,
+sync confirms. Queue ``rq`` is owned by A but published AND consumed via
+B, so every message crosses the data plane twice (push B->A, deliver
+A->B) and every confirm gates on A's mutation-log ship back to B. Mid-run
+a crash rule kills A; B must promote its replica and finish the workload
+locally. The stream queue lives on B and survives the crash.
+
+Determinism: the publisher consults the plan once per message at the
+``soak.tick`` site, so the crash fires at a fixed publish index for a
+given seed. Transport-site rules use invocation windows, making their
+schedule a pure function of the seed as well (see plan.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from . import ChaosStore, FaultPlan, FaultRule, _LazyRuntime, clear, install
+
+# logical crash-target name the plan uses; the harness maps it to node A
+CRASH_TARGET = "owner"
+
+BACKOFF_BUDGET_S = 5.0  # ReconnectBackoff max_s: no delay may exceed it
+
+
+def default_plan(seed: int, owner: str, messages: int) -> FaultPlan:
+    """The full seeded soak: partitions + node crash + slow store +
+    transport latency/disconnects. Windows are invocation-indexed so the
+    schedule is deterministic per seed; the crash rides the publisher's
+    ``soak.tick`` so it lands at a fixed publish index. Transport faults
+    that can strand state on A (lost settles, dropped deliver batches)
+    are windowed BEFORE the crash: failover requeues them from B's
+    replica, which is exactly the recovery the soak must prove."""
+    crash_at = max(10, int(messages * 0.55))
+    return FaultPlan(seed, [
+        FaultRule(name="crash-owner", kind="crash", sites=["soak.tick"],
+                  after=crash_at, count=1, nodes=[CRASH_TARGET]),
+        FaultRule(name="partition-to-owner", kind="partition",
+                  sites=["data.send"], nodes=[owner], after=20, until=45),
+        FaultRule(name="drop-deliver", kind="drop", sites=["data.event"],
+                  count=2, after=5, until=crash_at),
+        FaultRule(name="disconnect-data", kind="disconnect",
+                  sites=["data.read"], probability=0.05, count=2,
+                  until=crash_at),
+        FaultRule(name="wire-latency", kind="latency",
+                  sites=["data.send", "rpc.call"], probability=0.05,
+                  delay_ms=3),
+        FaultRule(name="slow-store", kind="latency", sites=["store.flush"],
+                  probability=0.3, delay_ms=8),
+    ])
+
+
+async def run_soak(
+    seed: int, *, messages: int = 160, stream_records: int = 40,
+    plan: Optional[FaultPlan] = None, metrics_sink=None,
+) -> dict:
+    """Run the workload under the plan; returns a report whose
+    ``violations`` list is empty iff every invariant held."""
+    from ..amqp.properties import BasicProperties
+    from ..client.client import AMQPClient
+    from ..store.memory import MemoryStore
+    from ..broker.server import BrokerServer
+    from ..cluster.node import ClusterNode
+
+    async def start_node(seeds):
+        srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                           store=MemoryStore())
+        await srv.start()
+        cl = ClusterNode(srv.broker, "127.0.0.1", 0, seeds,
+                         heartbeat_interval_s=0.2, failure_timeout_s=1.5,
+                         replicate_factor=2, replicate_sync=True,
+                         replicate_ack_timeout_ms=2000)
+        await cl.start()
+        return srv, cl
+
+    a_srv = a_cl = b_srv = b_cl = None
+    conns: list = []
+    violations: list[str] = []
+    try:
+        a_srv, a_cl = await start_node([])
+        b_srv, b_cl = await start_node([a_cl.name])
+        for _ in range(100):
+            if (len(a_cl.membership.alive_members()) == 2
+                    and len(b_cl.membership.alive_members()) == 2):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise RuntimeError("2-node membership did not converge")
+
+        rq = next(f"cq{i}" for i in range(200)
+                  if a_cl.queue_owner("/", f"cq{i}") == a_cl.name)
+        sq = next(f"cs{i}" for i in range(200)
+                  if a_cl.queue_owner("/", f"cs{i}") == b_cl.name)
+
+        if plan is None:
+            plan = default_plan(seed, a_cl.name, messages)
+        runtime = install(plan, metrics=metrics_sink or b_srv.broker.metrics)
+        fingerprint = plan.fingerprint()
+        # store seams on both nodes (the slow-store rule hits the flush
+        # barrier); the lazy shim keeps them live across install/clear
+        a_srv.broker.store = ChaosStore(a_srv.broker.store, _LazyRuntime())
+        b_srv.broker.store = ChaosStore(b_srv.broker.store, _LazyRuntime())
+
+        crashed = asyncio.Event()
+
+        def crash_owner() -> None:
+            async def _die():
+                # abrupt stop: no drain ordering — B must detect the
+                # silence (no leave protocol) and promote
+                for part in (a_cl, a_srv):
+                    try:
+                        await part.stop()
+                    except Exception:
+                        pass
+                crashed.set()
+            asyncio.get_event_loop().create_task(_die())
+
+        runtime.on_crash(CRASH_TARGET, crash_owner)
+
+        # -- consumer on B (remote consumer of A's queue, then local
+        #    consumer of the promoted replica after the crash)
+        persistent = BasicProperties(delivery_mode=2)
+        deliveries: dict[str, int] = {}
+        settle_mark = asyncio.Event()
+        post_settle: list[str] = []
+        delivered_event = asyncio.Event()
+
+        c_conn = await AMQPClient.connect("127.0.0.1", b_srv.bound_port)
+        conns.append(c_conn)
+        c_ch = await c_conn.channel()
+        await c_ch.basic_qos(prefetch_count=64)
+
+        def on_msg(msg):
+            body = bytes(msg.body).decode()
+            deliveries[body] = deliveries.get(body, 0) + 1
+            if settle_mark.is_set():
+                post_settle.append(body)
+            c_ch.basic_ack(msg.delivery_tag)
+            delivered_event.set()
+
+        p_conn = await AMQPClient.connect("127.0.0.1", b_srv.bound_port)
+        conns.append(p_conn)
+        p_ch = await p_conn.channel()
+        await p_ch.confirm_select()
+        await p_ch.queue_declare(rq, durable=True)
+        for _ in range(100):
+            if ("/", rq) in b_cl.queue_metas:
+                break
+            await asyncio.sleep(0.05)
+        await c_ch.basic_consume(rq, on_msg, consumer_tag="soak-consumer")
+
+        # -- publisher: one confirm-gated message at a time, reconnecting
+        #    through aborts/partitions; soak.tick drives the crash index
+        confirmed: set[int] = set()
+        attempts = 0
+        max_backoff_seen = 0.0
+
+        def observe_backoff() -> None:
+            nonlocal max_backoff_seen
+            for cl in (b_cl,):
+                for plane in cl._dataplanes.values():
+                    for st in plane.stats()["backoff"]:
+                        max_backoff_seen = max(max_backoff_seen,
+                                               st["delay_s"])
+
+        async def reconnect_publisher():
+            nonlocal p_conn, p_ch
+            try:
+                await p_conn.close()
+            except Exception:
+                pass
+            p_conn = await AMQPClient.connect("127.0.0.1",
+                                              b_srv.bound_port)
+            conns.append(p_conn)
+            p_ch = await p_conn.channel()
+            await p_ch.confirm_select()
+
+        for i in range(messages):
+            runtime.decide("soak.tick")  # deterministic crash index
+            body = f"m{i:06d}".encode()
+            for attempt in range(60):
+                attempts += 1
+                try:
+                    await p_ch.basic_publish_confirmed(
+                        body, routing_key=rq, properties=persistent,
+                        timeout=8)
+                    confirmed.add(i)
+                    break
+                except Exception:
+                    observe_backoff()
+                    await asyncio.sleep(0.25)
+                    try:
+                        await reconnect_publisher()
+                    except Exception:
+                        pass  # next attempt retries the dial
+            else:
+                violations.append(
+                    f"publish m{i:06d} never confirmed within the "
+                    f"reconnect budget")
+                break
+        observe_backoff()
+
+        # -- drain: every confirmed body delivered at least once, then the
+        #    surviving owner's queue runs empty (requeued strays included)
+        want = {f"m{i:06d}" for i in confirmed}
+
+        def surviving_queue():
+            for srv in (b_srv, a_srv):
+                if srv is None:
+                    continue
+                vhost = srv.broker.vhosts.get("/")
+                queue = vhost.queues.get(rq) if vhost else None
+                if queue is not None and queue.consumer_count:
+                    return queue
+            return None
+
+        deadline = asyncio.get_event_loop().time() + 45
+        while asyncio.get_event_loop().time() < deadline:
+            queue = surviving_queue()
+            if (want <= set(deliveries) and queue is not None
+                    and queue.message_count == 0
+                    and not queue.outstanding):
+                break
+            delivered_event.clear()
+            try:
+                await asyncio.wait_for(delivered_event.wait(), 0.25)
+            except asyncio.TimeoutError:
+                pass
+        missing = sorted(want - set(deliveries))
+        if missing:
+            violations.append(
+                f"confirmed-but-lost: {len(missing)} messages "
+                f"(first: {missing[:5]})")
+
+        # -- settle: duplicates beyond this point violate invariant 2
+        settle_mark.set()
+        await asyncio.sleep(0.7)
+        duplicates = sum(n - 1 for n in deliveries.values() if n > 1)
+        if post_settle:
+            violations.append(
+                f"{len(post_settle)} deliveries after settle "
+                f"(first: {post_settle[:5]})")
+
+        # -- promotion accounting (A's metrics survive its stop)
+        promotions = (a_srv.broker.metrics.repl_promotions
+                      + b_srv.broker.metrics.repl_promotions)
+        expect_crash = any(r.kind == "crash" for r in plan.rules)
+        if expect_crash:
+            if not crashed.is_set():
+                violations.append("crash rule never fired")
+            if promotions != 1:
+                violations.append(
+                    f"expected exactly 1 promotion, saw {promotions}")
+        elif promotions:
+            violations.append(f"unexpected promotion(s): {promotions}")
+
+        if max_backoff_seen > BACKOFF_BUDGET_S:
+            violations.append(
+                f"backoff delay {max_backoff_seen:.2f}s exceeded the "
+                f"{BACKOFF_BUDGET_S}s budget")
+
+        # -- stream cursor resume (on B, which survived)
+        stream = await _stream_cursor_check(
+            b_srv, sq, stream_records, violations)
+
+        return {
+            "seed": seed,
+            "fingerprint": fingerprint,
+            "messages": messages,
+            "confirmed": len(confirmed),
+            "publish_attempts": attempts,
+            "delivered_unique": len(set(deliveries) & want),
+            "duplicates": duplicates,
+            "post_settle_duplicates": len(post_settle),
+            "promotions": promotions,
+            "crashed": crashed.is_set(),
+            "max_backoff_s": round(max_backoff_seen, 3),
+            "stream": stream,
+            "chaos": runtime.status(),
+            "violations": violations,
+        }
+    finally:
+        clear()
+        for conn in conns:
+            try:
+                await conn.close()
+            except Exception:
+                pass
+        for part in (b_cl, b_srv, a_cl, a_srv):
+            if part is not None:
+                try:
+                    await part.stop()
+                except Exception:
+                    pass
+
+
+async def _stream_cursor_check(
+    srv, sq: str, records: int, violations: list[str]
+) -> dict:
+    """Invariant 4: publish a stream, ack half under one tag, detach,
+    reattach at "next" — deliveries must resume at committed+1 and run
+    contiguously to the tail."""
+    from ..amqp.properties import BasicProperties
+    from ..client.client import AMQPClient
+
+    conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    try:
+        pch = await conn.channel()
+        await pch.confirm_select()
+        await pch.queue_declare(
+            sq, durable=True, arguments={"x-queue-type": "stream"})
+        props = BasicProperties(delivery_mode=2)
+        for i in range(records):
+            pch.basic_publish(f"s{i:06d}".encode(), routing_key=sq,
+                              properties=props)
+        await pch.wait_unconfirmed_below(1, timeout=30)
+
+        half = records // 2
+        first_leg: list = []
+        got_half = asyncio.Event()
+        ch1 = await conn.channel()
+        await ch1.basic_qos(prefetch_count=records + 8)
+
+        def leg1(msg):
+            first_leg.append((msg.delivery_tag, bytes(msg.body).decode()))
+            if len(first_leg) == half:
+                got_half.set()
+
+        await ch1.basic_consume(
+            sq, leg1, consumer_tag="soak-cursor",
+            arguments={"x-stream-offset": "first"})
+        await asyncio.wait_for(got_half.wait(), 15)
+        # commit the cursor through record half-1, then detach
+        ch1.basic_ack(first_leg[half - 1][0], multiple=True)
+        await asyncio.sleep(0.3)  # let the commit land
+        await ch1.basic_cancel("soak-cursor")
+
+        second_leg: list = []
+        done = asyncio.Event()
+        ch2 = await conn.channel()
+        await ch2.basic_qos(prefetch_count=records + 8)
+
+        def leg2(msg):
+            second_leg.append(bytes(msg.body).decode())
+            if len(second_leg) >= records - half:
+                done.set()
+
+        await ch2.basic_consume(
+            sq, leg2, consumer_tag="soak-cursor",
+            arguments={"x-stream-offset": "next"})
+        try:
+            await asyncio.wait_for(done.wait(), 15)
+        except asyncio.TimeoutError:
+            pass
+        expected = [f"s{i:06d}" for i in range(half, records)]
+        resumed_ok = second_leg[:len(expected)] == expected \
+            and len(second_leg) >= len(expected)
+        if not resumed_ok:
+            violations.append(
+                f"stream cursor did not resume contiguously at committed+1 "
+                f"(expected s{half:06d}.., got {second_leg[:3]})")
+        return {
+            "records": records,
+            "committed_through": half - 1,
+            "resumed_at": second_leg[0] if second_leg else None,
+            "contiguous": resumed_ok,
+        }
+    finally:
+        try:
+            await conn.close()
+        except Exception:
+            pass

@@ -48,6 +48,7 @@ import logging
 import struct
 from typing import Iterator, Optional
 
+from .. import chaos
 from .rpc import (
     KIND_DEVENT,
     KIND_DREQUEST,
@@ -61,6 +62,10 @@ from .rpc import (
 )
 
 log = logging.getLogger("chanamq.dataplane")
+
+
+def _chaos_data_error(fault) -> RpcError:
+    return RpcError(fault.code, fault.message)
 
 METHOD_PUSH_MANY = 1
 METHOD_SETTLE_MANY = 2
@@ -297,7 +302,13 @@ class DataStream:
         self._backoff = ReconnectBackoff()
         self._window = asyncio.Semaphore(max(1, inflight))
         self.inflight = 0
+        self.last_error: Optional[str] = None
         self.closed = False
+
+    def backoff_state(self) -> dict:
+        state = self._backoff.state()
+        state["last_error"] = self.last_error
+        return state
 
     async def _ensure_connected(self) -> asyncio.StreamWriter:
         if self._writer is not None and not self._writer.is_closing():
@@ -308,11 +319,18 @@ class DataStream:
                 return self._writer
             self._backoff.check()
             try:
+                if chaos.ACTIVE is not None:
+                    fault = await chaos.ACTIVE.fire(
+                        "data.connect", peer=f"{self.host}:{self.port}",
+                        on_error=_chaos_data_error)
+                    if fault is not None:
+                        raise RpcError(fault.code, fault.message)
                 reader, writer = await asyncio.wait_for(
                     asyncio.open_connection(self.host, self.port),
                     self.connect_timeout_s)
-            except BaseException:
+            except BaseException as exc:
                 self._backoff.failed()
+                self.last_error = repr(exc)
                 raise
             self._backoff.succeeded()
             self._writer = writer
@@ -326,6 +344,19 @@ class DataStream:
         try:
             while True:
                 corr_id, kind, _method, payload = await _read_frame(reader)
+                if chaos.ACTIVE is not None:
+                    fault = chaos.ACTIVE.decide(
+                        "data.read", peer=f"{self.host}:{self.port}")
+                    if fault is not None:
+                        if fault.kind == "latency":
+                            await asyncio.sleep(fault.delay_s)
+                        elif fault.kind == "drop":
+                            continue  # response lost in flight
+                        elif fault.kind in ("disconnect", "partition"):
+                            break
+                        else:  # error / corrupt: stream desync
+                            raise FrameTooLarge(
+                                f"chaos[{fault.rule}]: {fault.message}")
                 if self.metrics is not None:
                     self.metrics.rpc_data_bytes_recv += len(payload) + 14
                 if kind != KIND_DRESPONSE:
@@ -339,11 +370,12 @@ class DataStream:
                     n = payload[1]
                     fut.set_exception(RpcError(
                         "remote", str(payload[2:2 + n], "utf-8", "replace")))
-        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
-            pass
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError) as exc:
+            self.last_error = repr(exc)
         except FrameTooLarge as exc:
             log.warning("data stream %s:%s desynced: %s; reconnecting",
                         self.host, self.port, exc)
+            self.last_error = repr(exc)
         finally:
             self._fail_waiters(
                 RpcError("disconnected", f"{self.host}:{self.port}"))
@@ -373,6 +405,17 @@ class DataStream:
         self.inflight += 1
         try:
             writer = await self._ensure_connected()
+            if chaos.ACTIVE is not None:
+                fault = await chaos.ACTIVE.fire(
+                    "data.send", peer=f"{self.host}:{self.port}",
+                    on_error=_chaos_data_error)
+                if fault is not None:
+                    if fault.kind == "drop":
+                        # batch lost in flight: fail now, not after the
+                        # full ask window
+                        raise RpcTimeout(f"data:{method_id}")
+                    writer.close()  # disconnect / corrupt
+                    raise RpcError("disconnected", f"chaos[{fault.rule}]")
             corr_id = self._next_corr
             self._next_corr += 1
             fut: asyncio.Future = asyncio.get_event_loop().create_future()
@@ -393,6 +436,12 @@ class DataStream:
 
     async def send_event(self, method_id: int, parts: list) -> None:
         writer = await self._ensure_connected()
+        if chaos.ACTIVE is not None:
+            fault = await chaos.ACTIVE.fire(
+                "data.event", peer=f"{self.host}:{self.port}",
+                on_error=_chaos_data_error)
+            if fault is not None:
+                return  # fire-and-forget: any transport fault = silent loss
         frame = encode_data_frame(0, KIND_DEVENT, method_id, parts)
         if self.metrics is not None:
             self.metrics.rpc_data_bytes_sent += sum(len(p) for p in frame)
@@ -640,6 +689,7 @@ class PeerDataPlane:
         return {
             "streams": len(self.streams),
             "inflight": [s.inflight for s in self.streams],
+            "backoff": [s.backoff_state() for s in self.streams],
             "buffered_push_records": sum(
                 a[1] for a in self._push if a is not None),
             "buffered_push_bytes": sum(

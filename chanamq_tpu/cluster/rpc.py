@@ -25,10 +25,12 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import struct
 from io import BytesIO
 from typing import Awaitable, Callable, Optional, Union
 
+from .. import chaos
 from ..amqp import value_codec as vc
 
 log = logging.getLogger("chanamq.rpc")
@@ -61,6 +63,10 @@ class RpcError(Exception):
 class RpcTimeout(RpcError):
     def __init__(self, method: str) -> None:
         super().__init__("timeout", f"rpc {method} timed out")
+
+
+def _chaos_rpc_error(fault) -> RpcError:
+    return RpcError(fault.code, fault.message)
 
 
 def _encode(corr_id: int, kind: int, method: str, payload: dict) -> bytes:
@@ -263,16 +269,23 @@ class RpcServer:
 
 
 class ReconnectBackoff:
-    """Exponential backoff shared by the control and data clients: after a
-    failed connect, further attempts fail IMMEDIATELY until the deadline so
-    a dead peer costs callers one fast exception, not a connect timeout
-    each (satellite of the stacked interconnect PR). Success resets it."""
+    """Backoff shared by the control and data clients: after a failed
+    connect, further attempts fail IMMEDIATELY until the deadline so a
+    dead peer costs callers one fast exception, not a connect timeout
+    each (satellite of the stacked interconnect PR). Success resets it.
 
-    __slots__ = ("base_s", "max_s", "_delay_s", "_retry_at")
+    Delay growth is decorrelated jitter — next = uniform(base, prev*3),
+    capped at max_s — so N clients dropped by the same peer failure spread
+    their reconnects instead of retrying in lockstep. When a seeded chaos
+    plan is active the draw comes from the plan's RNG, keeping chaos runs
+    reproducible."""
+
+    __slots__ = ("base_s", "max_s", "failures", "_delay_s", "_retry_at")
 
     def __init__(self, base_s: float = 0.1, max_s: float = 5.0) -> None:
         self.base_s = base_s
         self.max_s = max_s
+        self.failures = 0  # consecutive failed connects since last success
         self._delay_s = 0.0
         self._retry_at = 0.0
 
@@ -282,12 +295,24 @@ class ReconnectBackoff:
                 "backoff", f"reconnect suppressed for {self._delay_s:.1f}s")
 
     def failed(self) -> None:
+        prev = self._delay_s if self._delay_s else self.base_s
+        rng = chaos.backoff_rng() or random
         self._delay_s = min(
-            self.max_s, (self._delay_s * 2) if self._delay_s else self.base_s)
+            self.max_s,
+            rng.uniform(self.base_s, max(self.base_s, prev * 3)))
+        self.failures += 1
         self._retry_at = asyncio.get_event_loop().time() + self._delay_s
 
     def succeeded(self) -> None:
         self._delay_s = 0.0
+        self.failures = 0
+
+    def state(self) -> dict:
+        """Current backoff posture, surfaced by /admin/cluster."""
+        return {
+            "delay_s": round(self._delay_s, 4),
+            "consecutive_failures": self.failures,
+        }
 
 
 class RpcClient:
@@ -312,7 +337,13 @@ class RpcClient:
         self._next_corr = 1
         self._connect_lock = asyncio.Lock()
         self._backoff = ReconnectBackoff()
+        self.last_error: Optional[str] = None
         self.closed = False
+
+    def backoff_state(self) -> dict:
+        state = self._backoff.state()
+        state["last_error"] = self.last_error
+        return state
 
     async def _ensure_connected(self) -> asyncio.StreamWriter:
         if self._writer is not None and not self._writer.is_closing():
@@ -326,11 +357,18 @@ class RpcClient:
                 return self._writer
             self._backoff.check()
             try:
+                if chaos.ACTIVE is not None:
+                    fault = await chaos.ACTIVE.fire(
+                        "rpc.connect", peer=f"{self.host}:{self.port}",
+                        on_error=_chaos_rpc_error)
+                    if fault is not None:
+                        raise RpcError(fault.code, fault.message)
                 reader, writer = await asyncio.wait_for(
                     asyncio.open_connection(self.host, self.port),
                     self.connect_timeout_s)
-            except BaseException:
+            except BaseException as exc:
                 self._backoff.failed()
+                self.last_error = repr(exc)
                 # requests already queued on the lock see the fresh backoff
                 raise
             self._backoff.succeeded()
@@ -345,6 +383,19 @@ class RpcClient:
         try:
             while True:
                 corr_id, kind, _method, payload = await _read_frame(reader)
+                if chaos.ACTIVE is not None:
+                    fault = chaos.ACTIVE.decide(
+                        "rpc.read", peer=f"{self.host}:{self.port}")
+                    if fault is not None:
+                        if fault.kind == "latency":
+                            await asyncio.sleep(fault.delay_s)
+                        elif fault.kind == "drop":
+                            continue  # frame lost in flight
+                        elif fault.kind in ("disconnect", "partition"):
+                            break  # transport dies; finally reconnects
+                        else:  # error / corrupt: stream desync
+                            raise FrameTooLarge(
+                                f"chaos[{fault.rule}]: {fault.message}")
                 fut = self._waiters.pop(corr_id, None)
                 if fut is None or fut.done():
                     continue
@@ -354,14 +405,15 @@ class RpcClient:
                     fut.set_exception(RpcError(
                         str(payload.get("code", "unknown")),
                         str(payload.get("message", ""))))
-        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
-            pass
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError) as exc:
+            self.last_error = repr(exc)
         except FrameTooLarge as exc:
             # mid-stream desync: close the transport (finally below) so the
             # next call reconnects cleanly; in-flight waiters fail with a
             # reconnectable error rather than the loop dying unobserved
             log.warning("rpc client %s:%s desynced: %s; reconnecting",
                         self.host, self.port, exc)
+            self.last_error = repr(exc)
         finally:
             self._fail_waiters(RpcError("disconnected", f"{self.host}:{self.port}"))
             # close OUR writer (dead peer), not whatever reconnect may have
@@ -387,6 +439,17 @@ class RpcClient:
         timeout_s: Optional[float] = None,
     ) -> dict:
         writer = await self._ensure_connected()
+        if chaos.ACTIVE is not None:
+            fault = await chaos.ACTIVE.fire(
+                "rpc.call", peer=f"{self.host}:{self.port}",
+                on_error=_chaos_rpc_error)
+            if fault is not None:
+                if fault.kind == "drop":
+                    # request lost in flight: surface the timeout now
+                    # instead of making the soak wait out the ask window
+                    raise RpcTimeout(method)
+                writer.close()  # disconnect / corrupt: kill the transport
+                raise RpcError("disconnected", f"chaos[{fault.rule}]")
         corr_id = self._next_corr
         self._next_corr += 1
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
@@ -402,6 +465,12 @@ class RpcClient:
     async def send_event(self, method: str, payload: Optional[dict] = None) -> None:
         """Fire-and-forget (the reference's `tell`)."""
         writer = await self._ensure_connected()
+        if chaos.ACTIVE is not None:
+            fault = await chaos.ACTIVE.fire(
+                "rpc.event", peer=f"{self.host}:{self.port}",
+                on_error=_chaos_rpc_error)
+            if fault is not None:
+                return  # fire-and-forget: any transport fault = silent loss
         writer.write(_encode(0, KIND_EVENT, method, payload or {}))
         await writer.drain()
 

@@ -140,6 +140,14 @@ DEFAULTS: dict[str, Any] = {
     # records one cursor may take per coalesced dispatch pass (fairness
     # slice across cursors; prefetch credit still gates each delivery)
     "chana.mq.stream.delivery-batch": 128,
+    # fault injection (chanamq_tpu/chaos/): disabled by default — the
+    # broker's I/O seams stay no-op hooks unless this is set at boot
+    "chana.mq.chaos.enabled": False,
+    # RNG seed for the deterministic fault schedule (same seed = same run)
+    "chana.mq.chaos.seed": 0,
+    # optional path to a JSON fault-plan file installed at boot; empty =
+    # chaos armed but idle until a plan arrives via POST /admin/chaos/install
+    "chana.mq.chaos.plan": "",
 }
 
 _DURATION_RE = re.compile(r"^\s*([0-9.]+)\s*(ms|s|m|h|d)?\s*$")

@@ -29,6 +29,7 @@ import time
 from collections import deque
 from typing import TYPE_CHECKING, Optional
 
+from .. import chaos
 from ..store.api import StoredQueue
 from .applier import ReplicaApplier
 
@@ -229,6 +230,14 @@ class ReplicationManager:
     ) -> None:
         t0 = time.perf_counter()
         try:
+            if chaos.ACTIVE is not None:
+                fault = await chaos.ACTIVE.fire(
+                    "repl.ship", peer=follower,
+                    on_error=lambda f: OSError(f"chaos[{f.rule}]: {f.message}"))
+                if fault is not None:
+                    # batch lost toward this follower: it gap-detects on the
+                    # next one and resyncs wholesale (the designed path)
+                    raise OSError(f"chaos[{fault.rule}]: batch dropped")
             reply = await self.client_for(follower).call(
                 "repl.append", payload, timeout_s=self.ack_timeout_s)
             applied = int(reply.get("applied", 0))

@@ -58,12 +58,25 @@ class AdminServer:
             if len(parts) < 2:
                 return
             method, path = parts[0], parts[1]
-            # drain headers
+            # drain headers, keeping Content-Length so POST bodies (the
+            # /admin/chaos/install plan JSON) can be read
+            content_length = 0
             while True:
                 line = await asyncio.wait_for(reader.readline(), 10)
                 if line in (b"\r\n", b"\n", b""):
                     break
-            status, payload = await self._route(method, path)
+                name, _, value = line.decode("latin-1").partition(":")
+                if name.strip().lower() == "content-length":
+                    try:
+                        content_length = int(value.strip())
+                    except ValueError:
+                        pass
+            body = b""
+            if content_length > 0:
+                # 1 MiB cap: admin bodies are small JSON documents
+                body = await asyncio.wait_for(
+                    reader.readexactly(min(content_length, 1 << 20)), 10)
+            status, payload = await self._route(method, path, body)
             if isinstance(payload, str):
                 # pre-rendered text body (Prometheus exposition format)
                 body = payload.encode()
@@ -90,9 +103,11 @@ class AdminServer:
             except Exception:
                 pass
 
-    async def _route(self, method: str, path: str) -> tuple[str, object]:
+    async def _route(
+        self, method: str, path: str, body: bytes = b""
+    ) -> tuple[str, object]:
         segments = [unquote(s) for s in path.strip("/").split("/") if s]
-        matched = self._match(segments)
+        matched = self._match(segments, body)
         if matched is None:
             # unknown path: 404 regardless of verb
             return "404 Not Found", {"error": "unknown path"}
@@ -110,7 +125,7 @@ class AdminServer:
         except Exception as exc:
             return "500 Internal Server Error", {"error": str(exc)}
 
-    def _match(self, segments: list):
+    def _match(self, segments: list, body: bytes = b""):
         """Resolve a path to (allowed_method, handler) or None. Handlers
         may be sync or async; mutations require POST (a GET mutation is
         CSRF-triggerable from any web page even on localhost), reads GET.
@@ -145,7 +160,49 @@ class AdminServer:
             return ("GET", self._replication)
         if rest == ["forecast"]:
             return ("GET", self._forecast)
+        if rest == ["chaos"]:
+            return ("GET", self._chaos_status)
+        if rest == ["chaos", "install"]:
+            return ("POST", lambda: self._chaos_install(body))
+        if rest == ["chaos", "clear"]:
+            return ("POST", self._chaos_clear)
         return None
+
+    # -- fault injection (chanamq_tpu/chaos/) ------------------------------
+
+    def _chaos_status(self) -> dict:
+        from .. import chaos
+
+        runtime = chaos.ACTIVE
+        out = {
+            "enabled": bool(getattr(self.broker, "chaos_enabled", False)),
+            "installed": runtime is not None,
+        }
+        if runtime is not None:
+            out.update(runtime.status())
+        return out
+
+    def _chaos_install(self, body: bytes) -> dict:
+        from .. import chaos
+
+        if not getattr(self.broker, "chaos_enabled", False):
+            raise RuntimeError(
+                "chaos disabled: boot with chana.mq.chaos.enabled")
+        plan = chaos.FaultPlan.from_dict(json.loads(body or b"{}"))
+        chaos.install(plan, metrics=self.broker.metrics)
+        return {
+            "ok": True,
+            "seed": plan.seed,
+            "rules": [r.name for r in plan.rules],
+            "fingerprint": plan.fingerprint(),
+        }
+
+    def _chaos_clear(self) -> dict:
+        from .. import chaos
+
+        fires = chaos.ACTIVE.plan.total_fires if chaos.ACTIVE else 0
+        chaos.clear()
+        return {"ok": True, "total_fires": fires}
 
     async def _vhost_put(self, name: str) -> dict:
         await self.broker.create_vhost(name)
@@ -175,6 +232,9 @@ class AdminServer:
         "stream_appends", "stream_append_bytes", "stream_segments_sealed",
         "stream_segments_truncated", "stream_records_delivered",
         "stream_cursor_commits",
+        "chaos_fires", "chaos_latency", "chaos_errors", "chaos_drops",
+        "chaos_disconnects", "chaos_corrupt_frames", "chaos_crashes",
+        "chaos_partition_drops",
     })
 
     @staticmethod
@@ -360,12 +420,19 @@ class AdminServer:
 
     def _interconnect(self, cluster) -> dict:
         """Data-plane fast-path state: per-peer stream depth / buffered
-        micro-batches plus the global binary-frame counters."""
+        micro-batches (each stream reports its reconnect-backoff posture:
+        current delay, consecutive failures, last error) plus the
+        control-plane clients' backoff and the global binary-frame
+        counters."""
         m = self.broker.metrics
         return {
             "peers": {
                 peer: plane.stats()
                 for peer, plane in cluster._dataplanes.items()
+            },
+            "control": {
+                name: client.backoff_state()
+                for name, client in cluster.membership._clients.items()
             },
             "data_bytes_sent": m.rpc_data_bytes_sent,
             "data_bytes_recv": m.rpc_data_bytes_recv,

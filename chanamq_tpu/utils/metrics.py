@@ -101,6 +101,15 @@ class Metrics:
         self.rpc_flush_bytes = 0
         self.rpc_flush_count = 0
         self.rpc_flush_demand = 0
+        # fault injection (chanamq_tpu/chaos/): all zero unless a plan fires
+        self.chaos_fires = 0
+        self.chaos_latency = 0
+        self.chaos_errors = 0
+        self.chaos_drops = 0
+        self.chaos_disconnects = 0
+        self.chaos_corrupt_frames = 0
+        self.chaos_crashes = 0
+        self.chaos_partition_drops = 0
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -157,4 +166,12 @@ class Metrics:
             "rpc_flush_bytes": self.rpc_flush_bytes,
             "rpc_flush_count": self.rpc_flush_count,
             "rpc_flush_demand": self.rpc_flush_demand,
+            "chaos_fires": self.chaos_fires,
+            "chaos_latency": self.chaos_latency,
+            "chaos_errors": self.chaos_errors,
+            "chaos_drops": self.chaos_drops,
+            "chaos_disconnects": self.chaos_disconnects,
+            "chaos_corrupt_frames": self.chaos_corrupt_frames,
+            "chaos_crashes": self.chaos_crashes,
+            "chaos_partition_drops": self.chaos_partition_drops,
         }

@@ -20,6 +20,13 @@ BENCH_SECONDS=5 timeout -k 10 120 python bench.py --cluster || {
     exit "$rc"
 }
 
+echo "tier1: seeded chaos soak smoke (~5 s: partition + owner crash + slow store)"
+CHAOS_MESSAGES=80 timeout -k 10 180 python bench.py --chaos --seed 42 || {
+    rc=$?
+    echo "tier1: chaos soak smoke FAILED (rc=$rc) — invariant violation or harness error" >&2
+    exit "$rc"
+}
+
 echo "tier1: stream bench smoke (5 s)"
 BENCH_SECONDS=5 timeout -k 10 120 python bench.py --stream || {
     rc=$?
