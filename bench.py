@@ -223,7 +223,8 @@ def _tail(path: str, limit: int = 2000) -> str:
         return ""
 
 
-def run_spec(name: str, rate: int = 0) -> dict:
+def run_spec(name: str, rate: int = 0,
+             extra_env: "dict | None" = None) -> dict:
     persistent = False
     exchange_type = "direct"
     queues = None  # default bench_q/bench
@@ -247,6 +248,8 @@ def run_spec(name: str, rate: int = 0) -> dict:
         auto_ack, persistent, producers, consumers = SPECS[name]
     port = free_port()
     env = {**os.environ, "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))}
+    if extra_env:
+        env.update(extra_env)
     broker_args = [sys.executable, "-m", "chanamq_tpu.broker.server",
                    "--host", "127.0.0.1", "--port", str(port),
                    "--no-admin", "--log-level", "WARNING"]
@@ -380,6 +383,44 @@ async def _start_cluster_node(seeds, store_factory, **cluster_kwargs):
     return srv, cl
 
 
+async def _admin_get(port: int, path: str) -> dict:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    raw = await asyncio.wait_for(reader.read(-1), 10)
+    writer.close()
+    return json.loads(raw.partition(b"\r\n\r\n")[2])
+
+
+async def _trace_gate(admin_port: int, node_names: set) -> dict:
+    """BENCH_TRACE=1 smoke gate: scrape /admin/traces and demand at least
+    one stitched cross-node trace (>=5 stages spanning >=2 nodes) — the
+    whole point of the trailer propagation. Raises to fail the bench."""
+    body = await _admin_get(admin_port, "/admin/traces")
+    traces = body.get("recent", []) + body.get("slow", [])
+    best = None
+    for t in traces:
+        if len(t.get("nodes", [])) >= 2 and t.get("spans", 0) >= 5:
+            if best is None or t["spans"] > best["spans"]:
+                best = t
+    if best is None:
+        raise RuntimeError(
+            f"no stitched cross-node trace with >=5 stages among "
+            f"{len(traces)} captured (nodes={sorted(node_names)})")
+    from urllib.parse import quote
+
+    detail = await _admin_get(
+        admin_port, f"/admin/traces/{quote(best['id'], safe='')}")
+    return {
+        "stitched_id": best["id"],
+        "spans": best["spans"],
+        "nodes": best["nodes"],
+        "total_us": best["total_us"],
+        "stages": sorted(detail.get("stages", {})),
+        "captured": len(traces),
+    }
+
+
 async def _cluster_spec() -> dict:
     """Two in-process nodes sharing a store: publish a burst via the
     NON-owner (batch-pipelined queue.push_many), then consume remotely
@@ -395,9 +436,23 @@ async def _cluster_spec() -> dict:
         return _start_cluster_node(seeds, lambda: SqliteStore(store))
 
     a_srv = a_cl = b_srv = b_cl = None
+    trace_mod = admin = None
     try:
         a_srv, a_cl = await start_node([])
         b_srv, b_cl = await start_node([a_cl.name])
+        if os.environ.get("BENCH_TRACE"):
+            # trace every publish and expose A's admin API so the tier-1
+            # smoke can demand a stitched cross-node trace (both brokers
+            # share the one in-process ACTIVE; per-broker trace_node still
+            # attributes each span to the right node)
+            from chanamq_tpu import trace as trace_mod
+            from chanamq_tpu.rest.admin import AdminServer
+
+            trace_mod.install(trace_mod.TraceRuntime(
+                sample_rate=1.0, ring_size=1024,
+                metrics=a_srv.broker.metrics, node=a_cl.name))
+            admin = AdminServer(a_srv.broker, port=0)
+            await admin.start()
         for _ in range(100):
             if (len(a_cl.membership.alive_members()) == 2
                     and len(b_cl.membership.alive_members()) == 2):
@@ -469,8 +524,14 @@ async def _cluster_spec() -> dict:
         lat_ns.sort()
         await c.close()
 
+        trace_gate = None
+        if trace_mod is not None:
+            trace_gate = await _trace_gate(admin.bound_port,
+                                           {a_cl.name, b_cl.name})
+
         am, bm = a_srv.broker.metrics, b_srv.broker.metrics
         return {
+            **({"trace_gate": trace_gate} if trace_gate is not None else {}),
             "publish_via_nonowner_msgs_per_s": round(publish_rate, 1),
             "remote_consume_msgs_per_s": round(consume_rate, 1),
             "remote_p50_us": round(lat_ns[len(lat_ns) // 2] / 1000, 1),
@@ -495,6 +556,13 @@ async def _cluster_spec() -> dict:
             },
         }
     finally:
+        if admin is not None:
+            try:
+                await admin.stop()
+            except Exception:
+                pass
+        if trace_mod is not None:
+            trace_mod.clear()
         for part in (b_cl, b_srv, a_cl, a_srv):
             if part is not None:
                 try:
@@ -807,6 +875,46 @@ def main() -> None:
         }))
         if "error" in result:
             sys.exit(1)  # the tier-1 smoke must fail loudly
+        return
+
+    if "--trace-overhead" in sys.argv:
+        # tracing-cost scenario: the headline transient/autoAck spec run
+        # three times — tracing off, the default 1% sample rate, and
+        # everything-sampled — reporting the throughput delta vs off.
+        # The broker is a subprocess, so tracing is switched via the
+        # CHANAMQ_* env overrides it reads at boot.
+        spec = "transient_autoack_3p3c"
+        runs: dict = {}
+        for rate_label, sample in (("off", None), ("r0.01", 0.01),
+                                   ("r1.0", 1.0)):
+            extra = None
+            if sample is not None:
+                extra = {"CHANAMQ_TRACE_ENABLED": "true",
+                         "CHANAMQ_TRACE_SAMPLE_RATE": str(sample)}
+            runs[rate_label] = run_spec(spec, extra_env=extra)
+            print(f"# trace_overhead {rate_label}: {runs[rate_label]}",
+                  file=sys.stderr)
+        base = runs["off"].get("delivered_per_s") or 0
+        deltas = {}
+        for label in ("r0.01", "r1.0"):
+            cur = runs[label].get("delivered_per_s")
+            deltas[label] = (round((cur - base) / base * 100, 2)
+                             if base and cur is not None else None)
+        errors = {k: v["error"] for k, v in runs.items() if "error" in v}
+        print(json.dumps({
+            "metric": "trace_overhead_pct_at_r0.01",
+            "value": deltas["r0.01"],
+            "unit": "%",
+            "vs_baseline": None,
+            "delta_pct": deltas,
+            "delivered_per_s": {
+                k: v.get("delivered_per_s") for k, v in runs.items()},
+            "body_bytes": BODY_BYTES,
+            "trace_overhead": runs,
+            **({"error": errors} if errors else {}),
+        }))
+        if errors:
+            sys.exit(1)
         return
 
     if "--replicate" in sys.argv:

@@ -17,7 +17,10 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import time
 from typing import Any, Awaitable, Optional
+
+from .. import trace
 
 from ..amqp.constants import ErrorCode, ExchangeType
 from ..amqp.properties import BasicProperties
@@ -72,6 +75,9 @@ class Broker:
         self.vhosts: dict[str, VHost] = {}
         # set by chanamq_tpu.cluster.node.ClusterNode when clustering is on
         self.cluster = None
+        # span attribution for message traces (chanamq_tpu/trace/):
+        # ClusterNode.start() overwrites with its host:port name
+        self.trace_node = "local"
         # set by chanamq_tpu.models.service.ForecastService when forecasting
         # is on (chana.mq.forecast.enabled); admin serves its snapshot
         self.forecaster = None
@@ -1172,13 +1178,22 @@ class Broker:
                 vhost_name, exchange_name, routing_key, properties, body,
                 mandatory=mandatory, immediate=immediate,
                 header_raw=header_raw, marks=marks, exrk_raw=exrk_raw)
+        tr = None
+        t_route = 0
+        if trace.ACTIVE is not None:
+            tr = trace.ACTIVE.begin_publish(self.trace_node)
+            if tr is not None:
+                t_route = time.perf_counter_ns()
         vhost, queue_names = self._publish_route(
             vhost_name, exchange_name, routing_key, properties)
         self.metrics.published(len(body))
+        if tr is not None:
+            tr.span(trace.ROUTE, t_route, time.perf_counter_ns(),
+                    self.trace_node)
         return await self._publish_clustered(
             vhost, exchange_name, routing_key, properties, body,
             queue_names, mandatory=mandatory, immediate=immediate,
-            header_raw=header_raw, marks=marks, pending=pending)
+            header_raw=header_raw, marks=marks, pending=pending, tr=tr)
 
     def publish_sync(
         self,
@@ -1199,6 +1214,12 @@ class Broker:
         per-message hot loop skips the coroutine machinery. Callers must
         check ``broker.cluster is None`` first."""
         assert self.cluster is None
+        tr = None
+        t_route = 0
+        if trace.ACTIVE is not None:
+            tr = trace.ACTIVE.begin_publish(self.trace_node)
+            if tr is not None:
+                t_route = time.perf_counter_ns()
         cache = self._route_cache
         if cache is not None:
             key = (vhost_name, exchange_name, routing_key)
@@ -1206,6 +1227,9 @@ class Broker:
             if queues is not None:
                 # cache hit: resolved Queue objects, no matcher walk
                 self.metrics.published(len(body))
+                if tr is not None:
+                    tr.span(trace.ROUTE, t_route, time.perf_counter_ns(),
+                            self.trace_node)
                 return self._publish_local(
                     queues, exchange_name, routing_key, properties,
                     body, immediate, header_raw, marks, exrk_raw)
@@ -1228,6 +1252,9 @@ class Broker:
                         self._route_cache = None
                 if self._route_cache is not None:
                     cache[key] = queues
+        if tr is not None:
+            tr.span(trace.ROUTE, t_route, time.perf_counter_ns(),
+                    self.trace_node)
         return self._publish_local(
             queues, exchange_name, routing_key, properties,
             body, immediate, header_raw, marks, exrk_raw)
@@ -1256,14 +1283,30 @@ class Broker:
         local, remote = self._cluster_route_cache[
             (vhost_name, exchange_name, routing_key)]
         self.metrics.published(len(body))
+        tr = None
+        if trace.ACTIVE is not None:
+            tr = trace.ACTIVE.begin_publish(self.trace_node)
+            if tr is not None:
+                # the route is a dict hit: charge it as one stamp pair
+                t_route = time.perf_counter_ns()
+                tr.span(trace.ROUTE, t_route, time.perf_counter_ns(),
+                        self.trace_node)
         if not local and not remote:
             return (False, True)
         props_raw = header_raw if header_raw is not None \
             else properties.encode_header(len(body))
-        for owner, names, head in remote:
-            pending.append((owner, (
-                vhost_name, names, exchange_name, routing_key,
-                props_raw, body, head)))
+        if tr is None:
+            for owner, names, head in remote:
+                pending.append((owner, (
+                    vhost_name, names, exchange_name, routing_key,
+                    props_raw, body, head)))
+        else:
+            # 8th element rides into PeerDataPlane.submit_push as its
+            # trace kwarg via submit_batch's *rec unpacking
+            for owner, names, head in remote:
+                pending.append((owner, (
+                    vhost_name, names, exchange_name, routing_key,
+                    props_raw, body, head, tr)))
         if local:
             self.push_local(local, properties, body, exchange_name,
                             routing_key, props_raw, marks)
@@ -1345,6 +1388,13 @@ class Broker:
             properties.expiration_ms(), header_raw=header_raw,
         )
         message.exrk_raw = exrk_raw
+        tr = None
+        t_enq = 0
+        if trace.ACTIVE is not None:
+            tr = trace.ACTIVE.current
+            if tr is not None:
+                message.trace = tr
+                t_enq = time.perf_counter_ns()
         message.refer_count = len(queues)
         self.account_message(message)
         # streams never reference the shared Message after push (the log
@@ -1367,6 +1417,9 @@ class Broker:
         body_size = len(body)
         for queue in queues:
             queue.push(message, body_size=body_size)
+        if tr is not None:
+            tr.span(trace.ENQUEUE, t_enq, time.perf_counter_ns(),
+                    self.trace_node)
         if marks is not None:
             mark1 = self.store.mark()
             if mark1 > mark0:
@@ -1380,6 +1433,7 @@ class Broker:
         header_raw: Optional[bytes] = None,
         marks: Optional[list[tuple[int, int]]] = None,
         pending: Optional[list] = None,
+        tr=None,
     ) -> tuple[bool, bool]:
         """Cluster publish: routing already happened locally on the
         replicated exchange metadata; per-owner queue.push RPCs carry the
@@ -1457,16 +1511,22 @@ class Broker:
             # surfaces at the barrier (confirm-mode: connection error,
             # never a false confirm; else best-effort, logged)
             for owner, names in by_owner.items():
-                pending.append((owner, (
-                    vhost.name, names, exchange_name, routing_key,
-                    props_raw, body)))
+                if tr is None:
+                    pending.append((owner, (
+                        vhost.name, names, exchange_name, routing_key,
+                        props_raw, body)))
+                else:
+                    pending.append((owner, (
+                        vhost.name, names, exchange_name, routing_key,
+                        props_raw, body, None, tr)))
                 pushed_remote = True
         else:
             for owner, names in by_owner.items():
                 try:
                     pushed, owner_had_consumer = await self.cluster.remote_push(
                         owner, vhost.name, names, props_raw, body,
-                        exchange_name, routing_key, check_consumers=False)
+                        exchange_name, routing_key, check_consumers=False,
+                        tr=tr)
                     pushed_remote = pushed_remote or pushed
                     had_consumer = had_consumer or owner_had_consumer
                 except Exception as exc:
@@ -1475,6 +1535,9 @@ class Broker:
             # every target was remote and none accepted: unroutable in effect
             return (False, True)
         if local:
+            if tr is not None and trace.ACTIVE is not None:
+                # re-pin: awaits above may have run other publishes
+                trace.ACTIVE.current = tr
             self.push_local(
                 local, properties, body, exchange_name, routing_key,
                 props_raw, marks)

@@ -16,6 +16,7 @@ import struct
 import time
 from typing import TYPE_CHECKING, Any, Optional
 
+from .. import trace
 from ..amqp.command import AMQCommand
 from ..amqp.constants import FRAME_OVERHEAD
 from ..amqp.methods import Basic
@@ -198,13 +199,24 @@ class ServerChannel:
         tag = self.next_delivery_tag()
         msg = qm.message
         body = msg.body
+        tr = None
+        if trace.ACTIVE is not None:
+            tr = msg.trace
+            if tr is not None:
+                t_del = time.perf_counter_ns()
         self.connection.send_bytes(
             self._render_deliver(consumer, tag, qm.redelivered, msg, body))
         metrics = self.connection.broker.metrics
         metrics.delivered(len(body))
         metrics.publish_to_deliver_us.observe_us(
             (time.perf_counter_ns() - msg.published_ns) / 1000.0)
+        if tr is not None:
+            tr.span(trace.DELIVER, t_del, time.perf_counter_ns(),
+                    self.connection.broker.trace_node)
         if consumer.no_ack:
+            if tr is not None:
+                # no-ack settles at delivery (AMQP 0-9-1 semantics)
+                trace.ACTIVE.on_settle(tr, self.connection.broker.trace_node)
             return None
         delivery = Delivery(qm, queue, self, consumer.tag, tag, no_ack=False)
         self.unacked[tag] = delivery

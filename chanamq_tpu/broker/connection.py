@@ -43,6 +43,7 @@ from ..amqp.constants import (
 from ..amqp.frame import Frame, FrameError, FrameParser, HEARTBEAT_BYTES
 from ..amqp import methods as am
 from ..amqp.properties import BasicProperties
+from .. import trace
 from .broker import Broker, BrokerError
 from .channel import ChannelMode, Consumer, ServerChannel
 
@@ -431,6 +432,11 @@ class AMQPConnection:
         if not data:
             raise ConnectionClosed()
         self._last_recv = time.monotonic()
+        if trace.ACTIVE is not None:
+            # ingress-parse spans start at the chunk read; one stamp per
+            # ~256 KiB read, not per message (begin_publish drops it when
+            # stale, e.g. an idle connection)
+            trace.ACTIVE.ingress_ns = time.perf_counter_ns()
         return data
 
     async def _handshake(self) -> None:

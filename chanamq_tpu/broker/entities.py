@@ -30,6 +30,7 @@ import time
 from collections import deque
 from typing import TYPE_CHECKING, Any, Callable, Optional
 
+from .. import trace
 from ..amqp.properties import BasicProperties
 from ..store.api import StoredMessage
 from .matchers import Matcher, matcher_for
@@ -52,7 +53,7 @@ class Message:
     __slots__ = (
         "id", "properties", "body", "exchange", "routing_key",
         "ttl_ms", "refer_count", "persisted", "published_ns", "header_raw",
-        "accounted", "paged", "exrk_raw",
+        "accounted", "paged", "exrk_raw", "trace",
     )
 
     def __init__(
@@ -89,6 +90,9 @@ class Message:
         # frames need it); captured from the publish frame when available,
         # else built lazily by the first deliver render
         self.exrk_raw: Optional[bytes] = None
+        # sampled trace riding this message (chanamq_tpu/trace/); attached
+        # by push_local / the data-plane handlers only when sampled
+        self.trace = None
 
     def header_payload(self) -> bytes:
         hp = self.header_raw
@@ -312,7 +316,14 @@ class Queue:
                 # before this call's own passivation below, so the body is
                 # normally still resident; a fanout sibling may already have
                 # paged it (body None) — the follower then resyncs the blob
-                self.repl.enqueue(qm, message)
+                if trace.ACTIVE is not None and message.trace is not None:
+                    t_repl = time.perf_counter_ns()
+                    self.repl.enqueue(qm, message)
+                    message.trace.span(
+                        trace.REPLICATE_SHIP, t_repl,
+                        time.perf_counter_ns(), self.broker.trace_node)
+                else:
+                    self.repl.enqueue(qm, message)
         # length/byte caps: drop-head overflow, dead-lettering each victim
         # (x-overflow=drop-head is the only supported policy; declare
         # rejects others). Runs before passivation so a dropped entry is
@@ -795,6 +806,10 @@ class Queue:
 
     def ack(self, delivery: Delivery) -> None:
         self._settle_store(delivery)
+        if trace.ACTIVE is not None:
+            tr = delivery.queued.message.trace
+            if tr is not None:
+                trace.ACTIVE.on_settle(tr, self.broker.trace_node)
         self.broker.unrefer(delivery.queued.message)
 
     def _flush_unack_deletes(self) -> None:
@@ -810,6 +825,10 @@ class Queue:
         """Reject without requeue: same store cleanup as ack, then the
         message dead-letters (reason "rejected") when a DLX is set."""
         self._settle_store(delivery)
+        if trace.ACTIVE is not None:
+            tr = delivery.queued.message.trace
+            if tr is not None:
+                trace.ACTIVE.on_settle(tr, self.broker.trace_node)
         self._settle_dead(delivery.queued, "rejected")
 
     def requeue(self, delivery: Delivery) -> None:

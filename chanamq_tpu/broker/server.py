@@ -285,6 +285,12 @@ async def run_node(config) -> None:
     from ..rest.admin import AdminServer
 
     server = BrokerServer.from_config(config)
+    if config.bool("chana.mq.log.json"):
+        # swap formatters before any traffic so every line is one JSON
+        # object stamped with node id + active trace id
+        from ..utils import logjson
+
+        logjson.install(server.broker)
     admin = None
     cluster = None
     forecaster = None
@@ -317,6 +323,13 @@ async def run_node(config) -> None:
             from .. import chaos as chaos_mod
 
             chaos_mod.enable_from_config(config, server.broker)
+        # tracing next (same ACTIVE-gate idiom as chaos): installed before
+        # the cluster starts so ClusterNode.start can rename the runtime's
+        # node tag from "local" to host:port
+        if config.bool("chana.mq.trace.enabled"):
+            from .. import trace as trace_mod
+
+            trace_mod.enable_from_config(config, server.broker)
         if config.bool("chana.mq.cluster.enabled"):
             from ..cluster.node import ClusterNode
 

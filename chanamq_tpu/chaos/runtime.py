@@ -21,6 +21,7 @@ import logging
 import random
 from typing import Callable, Optional
 
+from .. import trace
 from .plan import Fault, FaultPlan
 
 log = logging.getLogger("chanamq.chaos")
@@ -116,6 +117,11 @@ class ChaosRuntime:
             counter = _KIND_COUNTERS.get(fault.kind)
             if counter is not None:
                 setattr(m, counter, getattr(m, counter) + 1)
+        if trace.ACTIVE is not None:
+            # fault -> latency causality: tag the in-flight trace (if any)
+            # and remember the fire so traces whose window covers it get
+            # tagged at finish (chanamq_tpu/trace/)
+            trace.ACTIVE.note_chaos_fire(fault.rule)
         log.debug("chaos fire: rule=%s kind=%s site=%s",
                   fault.rule, fault.kind, site)
 

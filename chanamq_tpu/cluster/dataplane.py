@@ -39,6 +39,13 @@ Wire layout (shared head defined in rpc.py, kinds 4/5/6):
             u32 props-len | props | u32 body-len | body
 
 (`ss` = u8 length-prefixed UTF-8 short string.)
+
+All three payloads may carry an optional trace trailer AFTER the record
+area (chanamq_tpu/trace/): decoders iterate exactly ``count`` records and
+ignore trailing bytes, so peers without the trailer logic interoperate in
+both directions. The trailer is tail-anchored (length + magic in the last
+8 bytes) so a receiver lifts trace contexts before the lazy record
+decoders run; see trace.encode_trailer/decode_trailer.
 """
 
 from __future__ import annotations
@@ -46,9 +53,10 @@ from __future__ import annotations
 import asyncio
 import logging
 import struct
+import time
 from typing import Iterator, Optional
 
-from .. import chaos
+from .. import chaos, trace
 from .rpc import (
     KIND_DEVENT,
     KIND_DREQUEST,
@@ -476,9 +484,12 @@ class PeerDataPlane:
         self, host: str, port: int, *, streams: int = 2,
         inflight_per_stream: int = 32, flush_window_us: int = 200,
         flush_max_bytes: int = 1 << 20, flush_max_count: int = 512,
-        timeout_s: float = 20.0, metrics=None,
+        timeout_s: float = 20.0, metrics=None, node_tag: str = "",
     ) -> None:
         self.metrics = metrics
+        # local node name for trace span attribution (cluster-push and
+        # flush-wait happen on the submitting side)
+        self.node_tag = node_tag
         self.flush_window_s = max(0.0, flush_window_us / 1e6)
         self.flush_max_bytes = max(1, flush_max_bytes)
         self.flush_max_count = max(1, flush_max_count)
@@ -490,9 +501,9 @@ class PeerDataPlane:
         n = len(self.streams)
         # per-stream push accumulator: [parts, count, bytes, future]
         self._push: list[Optional[list]] = [None] * n
-        # per-stream settle accumulator: {(vhost, queue, op, tag):
-        #   [offsets, credit]} + shared future
-        self._settle: list[Optional[tuple[dict, asyncio.Future]]] = [None] * n
+        # per-stream settle accumulator: ({(vhost, queue, op, tag):
+        #   [offsets, credit]}, shared future, trace entries)
+        self._settle: list[Optional[tuple]] = [None] * n
         self._settle_inflight: set[asyncio.Future] = set()
         self._timer: Optional[asyncio.TimerHandle] = None
         self.closed = False
@@ -509,11 +520,13 @@ class PeerDataPlane:
     def submit_push(
         self, vhost: str, queues: list[str], exchange: str,
         routing_key: str, props_raw: bytes, body: bytes,
-        head: Optional[bytes] = None,
+        head: Optional[bytes] = None, tr=None,
     ) -> asyncio.Future:
         """Buffer one push; returns the covering batch's completion future.
         The caller's barrier awaits it; caps may flush the batch before the
-        window timer does. head: cached encode_push_meta_head, if any."""
+        window timer does. head: cached encode_push_meta_head, if any.
+        tr: sampled trace riding this record — parked locally and shipped
+        in the batch's trace trailer, keyed by record index."""
         idx = self.stream_for(vhost, queues[0] if queues else "")
         parts = encode_push_record(
             vhost, queues, exchange, routing_key, props_raw, body, head)
@@ -521,8 +534,16 @@ class PeerDataPlane:
         acc = self._push[idx]
         if acc is None:
             self._push[idx] = acc = [
-                [], 0, 0, asyncio.get_event_loop().create_future()]
+                [], 0, 0, asyncio.get_event_loop().create_future(), []]
             self._arm_timer()
+        if tr is not None:
+            acc[4].append((acc[1], tr))
+            tr.pending_ns = time.perf_counter_ns()
+            rt = trace.ACTIVE
+            if rt is not None:
+                rt.park(tr)
+            if self.metrics is not None:
+                self.metrics.trace_ctx_sent += 1
         acc[0].extend(parts)
         acc[1] += 1
         acc[2] += nbytes
@@ -542,19 +563,30 @@ class PeerDataPlane:
         acc, self._push[idx] = self._push[idx], None
         if acc is None:
             return
-        parts, count, _nbytes, fut = acc
+        parts, count, _nbytes, fut, traces = acc
         payload = [_U32.pack(count), *parts]
+        if traces:
+            payload.append(trace.encode_trailer(traces))
         stream = self.streams[idx]
         if self.metrics is not None:
             self.metrics.rpc_push_batches += 1
 
         async def _send() -> None:
+            t_sent = time.perf_counter_ns() if traces else 0
             try:
                 await stream.request(METHOD_PUSH_MANY, payload)
             except BaseException as exc:
                 if not fut.done():
                     fut.set_exception(exc)
                 return
+            if traces:
+                # batch-granular attribution: every trace in the batch
+                # shares the queue wait (submit->send) and the round trip
+                now = time.perf_counter_ns()
+                node = self.node_tag
+                for _i, tr in traces:
+                    tr.span(trace.CLUSTER_PUSH, tr.pending_ns, t_sent, node)
+                    tr.span(trace.FLUSH_WAIT, t_sent, now, node)
             if not fut.done():
                 fut.set_result(True)
 
@@ -567,15 +599,21 @@ class PeerDataPlane:
 
     def submit_settle(
         self, vhost: str, queue: str, op: str, offsets: list[int],
-        tag: str, credit: int,
+        tag: str, credit: int, tr=None,
     ) -> asyncio.Future:
         idx = self.stream_for(vhost, queue, tag)
         acc = self._settle[idx]
         if acc is None:
             self._settle[idx] = acc = (
-                {}, asyncio.get_event_loop().create_future())
+                {}, asyncio.get_event_loop().create_future(), [])
             self._arm_timer()
-        entries, fut = acc
+        entries, fut, traces = acc
+        if tr is not None:
+            # settle entries coalesce, so the trailer keys by entry order
+            # at flush time; idx here is a placeholder the flush rewrites
+            traces.append((len(traces), tr))
+            if self.metrics is not None:
+                self.metrics.trace_ctx_sent += 1
         key = (vhost, queue, op, tag)
         entry = entries.get(key)
         if entry is None:
@@ -590,11 +628,13 @@ class PeerDataPlane:
         acc, self._settle[idx] = self._settle[idx], None
         if acc is None:
             return
-        entries, fut = acc
+        entries, fut, traces = acc
         payload = [_U32.pack(len(entries))]
         for (vhost, queue, op, tag), (offsets, credit) in entries.items():
             payload.append(
                 encode_settle_entry(vhost, queue, op, tag, credit, offsets))
+        if traces:
+            payload.append(trace.encode_trailer(traces))
         stream = self.streams[idx]
         if self.metrics is not None:
             self.metrics.rpc_settle_batches += 1
@@ -633,13 +673,18 @@ class PeerDataPlane:
 
     def send_deliver_many(
         self, vhost: str, queue: str, tag: str, records: list,
-        count: int,
+        count: int, traces=None,
     ) -> None:
         """Fire one deliver_many event (owner -> origin), striped so one
         consumer's deliveries stay ordered. records is a pre-encoded buffer
-        list (see encode_deliver_record)."""
+        list (see encode_deliver_record). traces: [(record_idx, Trace)]
+        shipped as the trailing trace trailer."""
         idx = self.stream_for(vhost, queue, tag)
         payload = [encode_deliver_head(vhost, queue, tag, count), *records]
+        if traces:
+            payload.append(trace.encode_trailer(traces))
+            if self.metrics is not None:
+                self.metrics.trace_ctx_sent += len(traces)
         stream = self.streams[idx]
         if self.metrics is not None:
             self.metrics.rpc_deliver_records += count

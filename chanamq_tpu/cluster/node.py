@@ -29,8 +29,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import TYPE_CHECKING, Any, Optional
 
+from .. import trace
 from ..amqp.properties import BasicProperties
 from ..replicate import ReplicationManager
 from . import dataplane as dp
@@ -143,6 +145,11 @@ class ClusterNode:
     async def start(self) -> None:
         await self.rpc.start()
         self.name = f"{self._host}:{self.rpc.bound_port}"
+        # span attribution for message traces: this broker's spans carry
+        # the cluster name instead of the single-node "local"
+        self.broker.trace_node = self.name
+        if trace.ACTIVE is not None and trace.ACTIVE.node == "local":
+            trace.ACTIVE.node = self.name
         self.membership = Membership(
             self.name, self._seeds, self.rpc,
             heartbeat_interval_s=self._hb, failure_timeout_s=self._ft)
@@ -462,7 +469,8 @@ class ClusterNode:
                 flush_window_us=self._dp_flush_window_us,
                 flush_max_bytes=self._dp_flush_max_bytes,
                 flush_max_count=self._dp_flush_max_count,
-                metrics=self.broker.metrics)
+                metrics=self.broker.metrics,
+                node_tag=self.name)
             self._dataplanes[node] = plane
         return plane
 
@@ -755,12 +763,25 @@ class ClusterNode:
             return {"pushed": False, "had_consumer": had_consumer}
         if check_consumers and not had_consumer:
             return {"pushed": False, "had_consumer": False}
+        tr = None
+        rt = trace.ACTIVE
+        raw_tr = payload.get("_trace")
+        if raw_tr is not None and rt is not None:
+            tr = rt.adopt(trace.Trace.from_blob(bytes(raw_tr)))
+            self.broker.metrics.trace_ctx_recv += 1
         if queues:
             marks: list[tuple[int, int]] = []
+            if tr is not None:
+                rt.current = tr
+                t_apply = time.perf_counter_ns()
             message = self.broker.push_local(
                 queues, props, body,
                 str(payload["exchange"]), str(payload["routing_key"]),
                 bytes(payload["props_raw"]), marks)
+            if tr is not None:
+                tr.span(trace.REMOTE_APPLY, t_apply,
+                        time.perf_counter_ns(), self.name)
+                rt.current = None
             if message.persisted:
                 # the reply releases the origin's confirm: barrier on the
                 # group commit covering the blob + queue-log rows above
@@ -814,8 +835,14 @@ class ClusterNode:
         marks: list[tuple[int, int]] = []
         any_persisted = False
         rcache = self.resolve_cache
+        rt = trace.ACTIVE
+        tctx = trace.decode_trailer(view) if rt is not None else None
+        if tctx:
+            self.broker.metrics.trace_ctx_recv += len(tctx)
+        ridx = -1
         for vhost, names, exchange, routing_key, props_raw, body in \
                 dp.decode_push_many(view):
+            ridx += 1
             queues = []
             for name in names:
                 queue = rcache.get((vhost, name))
@@ -830,8 +857,17 @@ class ClusterNode:
             if not queues:
                 continue
             props = _props_memo(props_raw)
+            tr = tctx.get(ridx) if tctx else None
+            if tr is not None:
+                tr = rt.adopt(tr)
+                rt.current = tr
+                t_apply = time.perf_counter_ns()
             message = self.broker.push_local(
                 queues, props, body, exchange, routing_key, props_raw, marks)
+            if tr is not None:
+                tr.span(trace.REMOTE_APPLY, t_apply,
+                        time.perf_counter_ns(), self.name)
+                rt.current = None
             any_persisted = any_persisted or message.persisted
         if any_persisted:
             await self.broker.store.flush(marks)
@@ -845,6 +881,16 @@ class ClusterNode:
         flush window. Application order follows frame order, so an ack
         buffered before a requeue of the same consumer applies first."""
         self.broker.metrics.rpc_data_bytes_recv += len(view)
+        rt = trace.ACTIVE
+        if rt is not None:
+            tctx = trace.decode_trailer(view)
+            if tctx:
+                # merge origin-side deliver/settle spans into the owner's
+                # parked copies; the owner's queue.ack below finalizes its
+                # own view via message.trace
+                self.broker.metrics.trace_ctx_recv += len(tctx)
+                for wire_tr in tctx.values():
+                    rt.adopt(wire_tr)
         for vhost_name, queue_name, op, tag, credit, offsets in \
                 dp.decode_settle_many(view):
             vhost = self.broker.vhosts.get(vhost_name)
@@ -888,6 +934,10 @@ class ClusterNode:
             return None
         from ..broker.entities import Message, QueuedMessage
 
+        rt = trace.ACTIVE
+        tctx = trace.decode_trailer(view) if rt is not None else None
+        if tctx:
+            self.broker.metrics.trace_ctx_recv += len(tctx)
         applied = 0
         for (offset, redelivered, msg_id, expire_at_ms, exchange,
                 routing_key, props_raw, body) in records:
@@ -895,6 +945,13 @@ class ClusterNode:
             message = Message(
                 msg_id, props, body, exchange, routing_key,
                 header_raw=props_raw)
+            if tctx:
+                wire_tr = tctx.get(applied)
+                if wire_tr is not None:
+                    # stitch: the parked origin half (ingress/route/
+                    # cluster-push) merges with the owner-side spans the
+                    # trailer carried; deliver/settle stamp below
+                    message.trace = rt.adopt(wire_tr)
             qm = QueuedMessage(message, offset, expire_at_ms)
             qm.redelivered = redelivered
             channel.deliver(stub, stub.queue, qm)
@@ -1133,13 +1190,22 @@ class ClusterNode:
     async def remote_push(
         self, owner: str, vhost: str, queues: list[str], props_raw: bytes,
         body: bytes, exchange: str, routing_key: str, check_consumers: bool,
-        check_only: bool = False,
+        check_only: bool = False, tr=None,
     ) -> tuple[bool, bool]:
-        reply = await self._call(owner, "queue.push", {
+        payload = {
             "vhost": vhost, "queues": queues, "props_raw": props_raw,
             "body": body, "exchange": exchange, "routing_key": routing_key,
             "check_consumers": check_consumers, "check_only": check_only,
-        })
+        }
+        if tr is not None and not check_only:
+            # control-plane trace propagation (the slow mandatory/immediate
+            # path); the data plane carries it as the payload trailer
+            payload["_trace"] = tr.to_blob()
+            rt = trace.ACTIVE
+            if rt is not None:
+                rt.park(tr)
+            self.broker.metrics.trace_ctx_sent += 1
+        reply = await self._call(owner, "queue.push", payload)
         return bool(reply.get("pushed")), bool(reply.get("had_consumer"))
 
     async def remote_get(self, vhost: str, name: str, no_ack: bool) -> dict:
@@ -1213,7 +1279,7 @@ class ClusterNode:
             pass
 
     def settle_bg(self, vhost: str, name: str, op: str, offsets: list[int],
-                  tag: str = "", credit: int = 0) -> None:
+                  tag: str = "", credit: int = 0, tr=None) -> None:
         """Fire-and-forget settle (ack/drop/requeue) toward the queue
         owner via the data plane. Settles coalesce per (owner, queue, op,
         tag) inside the peer's flush window — a consumer acking a whole
@@ -1221,7 +1287,7 @@ class ClusterNode:
         settle_many frame, not one RPC per message."""
         owner = self.queue_owner(vhost, name)
         self.dataplane(owner).submit_settle(
-            vhost, name, op, offsets, tag, credit)
+            vhost, name, op, offsets, tag, credit, tr=tr)
 
     async def _drain_settles(self) -> None:
         """Flush + await every in-flight settle batch on every peer — the
@@ -1240,7 +1306,7 @@ class RemoteConsumer:
 
     __slots__ = ("cluster", "tag", "queue", "no_ack", "origin", "credit",
                  "exclusive", "priority", "outstanding_offsets", "_buf",
-                 "_buf_count", "_flush_scheduled")
+                 "_buf_count", "_flush_scheduled", "_traces")
 
     def __init__(self, cluster: ClusterNode, tag: str, queue: "Queue",
                  no_ack: bool, origin: str, credit: int,
@@ -1262,6 +1328,8 @@ class RemoteConsumer:
         self._buf: list = []
         self._buf_count = 0
         self._flush_scheduled = False
+        # (record_idx, Trace) entries riding the next deliver_many trailer
+        self._traces: list = []
 
     def can_take(self, next_size: int) -> bool:
         if self.credit <= 0:
@@ -1279,6 +1347,8 @@ class RemoteConsumer:
         self._buf.extend(dp.encode_deliver_record(
             qm.offset, qm.redelivered, msg.id, qm.expire_at_ms,
             msg.exchange, msg.routing_key, msg.header_payload(), msg.body))
+        if trace.ACTIVE is not None and msg.trace is not None:
+            self._traces.append((self._buf_count, msg.trace))
         self._buf_count += 1
         if not self._flush_scheduled:
             self._flush_scheduled = True
@@ -1301,10 +1371,12 @@ class RemoteConsumer:
             return
         records, self._buf = self._buf, []
         count, self._buf_count = self._buf_count, 0
+        traces, self._traces = self._traces, []
         plane = self.cluster.dataplane(self.origin)
         chunk: list = []
         chunk_count = 0
         size = 0
+        base = 0  # first record index of the current chunk
         # records is a flat [meta, body, meta, body, ...] buffer list
         for i in range(0, len(records), 2):
             chunk.append(records[i])
@@ -1314,12 +1386,18 @@ class RemoteConsumer:
             if size >= self._FLUSH_BYTES:
                 plane.send_deliver_many(
                     self.queue.vhost, self.queue.name, self.tag,
-                    chunk, chunk_count)
+                    chunk, chunk_count,
+                    traces=[(ri - base, t) for ri, t in traces
+                            if base <= ri < base + chunk_count]
+                    if traces else None)
+                base += chunk_count
                 chunk, chunk_count, size = [], 0, 0
         if chunk:
             plane.send_deliver_many(
                 self.queue.vhost, self.queue.name, self.tag,
-                chunk, chunk_count)
+                chunk, chunk_count,
+                traces=[(ri - base, t) for ri, t in traces if ri >= base]
+                if traces else None)
 
     def detach(self) -> None:
         """The owner's queue died under this remote consumer: tell the
@@ -1350,14 +1428,24 @@ class RemoteQueueRef:
     # channel bookkeeping hooks ------------------------------------------
 
     def ack(self, delivery: "Delivery") -> None:
+        tr = None
+        if trace.ACTIVE is not None:
+            tr = delivery.queued.message.trace
+            if tr is not None:
+                trace.ACTIVE.on_settle(tr, self.cluster.broker.trace_node)
         self.cluster.settle_bg(
             self.vhost, self.name, "ack", [delivery.queued.offset],
-            tag=delivery.consumer_tag, credit=1)
+            tag=delivery.consumer_tag, credit=1, tr=tr)
 
     def drop(self, delivery: "Delivery") -> None:
+        tr = None
+        if trace.ACTIVE is not None:
+            tr = delivery.queued.message.trace
+            if tr is not None:
+                trace.ACTIVE.on_settle(tr, self.cluster.broker.trace_node)
         self.cluster.settle_bg(
             self.vhost, self.name, "drop", [delivery.queued.offset],
-            tag=delivery.consumer_tag, credit=1)
+            tag=delivery.consumer_tag, credit=1, tr=tr)
 
     def requeue(self, delivery: "Delivery") -> None:
         self.cluster.settle_bg(

@@ -148,6 +148,19 @@ DEFAULTS: dict[str, Any] = {
     # optional path to a JSON fault-plan file installed at boot; empty =
     # chaos armed but idle until a plan arrives via POST /admin/chaos/install
     "chana.mq.chaos.plan": "",
+    # message tracing (chanamq_tpu/trace/): disabled by default — every
+    # hot-path seam stays a module-level `ACTIVE is None` check
+    "chana.mq.trace.enabled": False,
+    # fraction of publishes that mint a trace (0.0 .. 1.0); the sampling
+    # RNG is seeded from the chaos seed so soak runs sample deterministically
+    "chana.mq.trace.sample-rate": 0.01,
+    # completed traces kept in the recent ring (slow/chaos-tagged traces
+    # get a second ring of the same size so they survive churn)
+    "chana.mq.trace.ring-size": 256,
+    # traces slower end-to-end than this always land in the slow ring
+    "chana.mq.trace.slow-ms": 250,
+    # structured JSON log lines stamped with node id + active trace id
+    "chana.mq.log.json": False,
 }
 
 _DURATION_RE = re.compile(r"^\s*([0-9.]+)\s*(ms|s|m|h|d)?\s*$")
@@ -293,6 +306,11 @@ def _coerce(text: str, previous: Any) -> Any:
     if isinstance(previous, int) and not isinstance(previous, bool):
         try:
             return int(text)
+        except ValueError:
+            return text
+    if isinstance(previous, float):
+        try:
+            return float(text)
         except ValueError:
             return text
     if isinstance(previous, list):

@@ -166,7 +166,48 @@ class AdminServer:
             return ("POST", lambda: self._chaos_install(body))
         if rest == ["chaos", "clear"]:
             return ("POST", self._chaos_clear)
+        if rest == ["traces"]:
+            return ("GET", self._traces)
+        if len(rest) == 2 and rest[0] == "traces":
+            return ("GET", lambda: self._trace_detail(rest[1]))
         return None
+
+    # -- message tracing (chanamq_tpu/trace/) ------------------------------
+
+    def _traces(self) -> dict:
+        from .. import trace
+
+        runtime = trace.ACTIVE
+        out = {
+            "enabled": bool(getattr(self.broker, "trace_enabled", False)),
+            "installed": runtime is not None,
+        }
+        if runtime is not None:
+            out.update(runtime.status())
+            stage_hs = self.broker.metrics.trace_stage_us
+            out["stage_latency_us"] = {
+                key: {
+                    "count": h.count,
+                    "p50": h.percentile_us(0.50),
+                    "p99": h.percentile_us(0.99),
+                    "mean": h.mean_us,
+                }
+                for key, h in stage_hs.items()
+            }
+        return out
+
+    def _trace_detail(self, trace_id: str) -> dict:
+        from .. import trace
+
+        runtime = trace.ACTIVE
+        if runtime is None:
+            raise RuntimeError("tracing not installed")
+        found = runtime.find(trace_id)
+        if found is None:
+            raise RuntimeError(f"no trace {trace_id!r} in the rings")
+        out = found.to_dict()
+        out["finished"] = found.finished
+        return out
 
     # -- fault injection (chanamq_tpu/chaos/) ------------------------------
 
@@ -219,8 +260,9 @@ class AdminServer:
         return forecaster.snapshot()
 
     # metric name -> prometheus type; everything else in the snapshot is a
-    # gauge. Latency percentiles are exported as computed gauges (the
-    # histogram buckets aren't cumulative-format compatible as stored).
+    # gauge. Latency percentiles remain exported as computed gauges for
+    # dashboards that predate the proper histogram series; every Histogram
+    # is ALSO exported as cumulative _bucket/_sum/_count below.
     _PROM_COUNTERS = frozenset({
         "published_msgs", "published_bytes", "delivered_msgs",
         "delivered_bytes", "returned_msgs", "confirmed_msgs",
@@ -235,6 +277,9 @@ class AdminServer:
         "chaos_fires", "chaos_latency", "chaos_errors", "chaos_drops",
         "chaos_disconnects", "chaos_corrupt_frames", "chaos_crashes",
         "chaos_partition_drops",
+        "trace_sampled", "trace_completed", "trace_slow",
+        "trace_chaos_tagged", "trace_ctx_sent", "trace_ctx_recv",
+        "trace_evicted",
     })
 
     @staticmethod
@@ -256,6 +301,19 @@ class AdminServer:
             kind = "counter" if key in self._PROM_COUNTERS else "gauge"
             out.append(f"# TYPE chanamq_{key} {kind}")
             out.append(f"chanamq_{key} {value}")
+        # proper cumulative histogram series: the stored buckets are
+        # per-bound counts, so emit a running sum with +Inf last
+        for name, hist in self.broker.metrics.histograms().items():
+            out.append(f"# TYPE chanamq_{name} histogram")
+            cumulative = 0
+            for bound, count in zip(hist.BOUNDS, hist.buckets):
+                cumulative += count
+                out.append(
+                    f'chanamq_{name}_bucket{{le="{bound}"}} {cumulative}')
+            out.append(
+                f'chanamq_{name}_bucket{{le="+Inf"}} {hist.count}')
+            out.append(f"chanamq_{name}_sum {hist.total_us}")
+            out.append(f"chanamq_{name}_count {hist.count}")
         out.append("# TYPE chanamq_queue_messages gauge")
         out.append("# TYPE chanamq_queue_ready_bytes gauge")
         out.append("# TYPE chanamq_queue_unacked gauge")

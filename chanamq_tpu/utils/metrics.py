@@ -110,6 +110,17 @@ class Metrics:
         self.chaos_corrupt_frames = 0
         self.chaos_crashes = 0
         self.chaos_partition_drops = 0
+        # message tracing (chanamq_tpu/trace/): all zero unless installed.
+        # trace_stage_us is populated with one Histogram per pipeline stage
+        # by TraceRuntime at install time (key: trace_<stage>_us).
+        self.trace_sampled = 0
+        self.trace_completed = 0
+        self.trace_slow = 0
+        self.trace_chaos_tagged = 0
+        self.trace_ctx_sent = 0
+        self.trace_ctx_recv = 0
+        self.trace_evicted = 0
+        self.trace_stage_us: "dict[str, Histogram]" = {}
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -120,10 +131,19 @@ class Metrics:
         self.delivered_msgs += 1
         self.delivered_bytes += nbytes
 
+    def histograms(self) -> "dict[str, Histogram]":
+        """Every registered histogram, for cumulative Prometheus export."""
+        out = {
+            "publish_to_deliver_us": self.publish_to_deliver_us,
+            "repl_ack_us": self.repl_ack_us,
+        }
+        out.update(self.trace_stage_us)
+        return out
+
     def snapshot(self) -> dict:
         elapsed = time.time() - self.started_at
         h = self.publish_to_deliver_us
-        return {
+        out = {
             "uptime_s": round(elapsed, 3),
             "published_msgs": self.published_msgs,
             "published_bytes": self.published_bytes,
@@ -136,6 +156,8 @@ class Metrics:
             "connections_opened": self.connections_opened,
             "connections_closed": self.connections_closed,
             "connections_refused": self.connections_refused,
+            "connections_open": (
+                self.connections_opened - self.connections_closed),
             "publish_to_deliver_p50_us": h.percentile_us(0.50),
             "publish_to_deliver_p99_us": h.percentile_us(0.99),
             "publish_to_deliver_mean_us": h.mean_us,
@@ -174,4 +196,17 @@ class Metrics:
             "chaos_corrupt_frames": self.chaos_corrupt_frames,
             "chaos_crashes": self.chaos_crashes,
             "chaos_partition_drops": self.chaos_partition_drops,
+            "trace_sampled": self.trace_sampled,
+            "trace_completed": self.trace_completed,
+            "trace_slow": self.trace_slow,
+            "trace_chaos_tagged": self.trace_chaos_tagged,
+            "trace_ctx_sent": self.trace_ctx_sent,
+            "trace_ctx_recv": self.trace_ctx_recv,
+            "trace_evicted": self.trace_evicted,
         }
+        for key, hist in self.trace_stage_us.items():
+            base = key[:-3] if key.endswith("_us") else key
+            out[f"{base}_p50_us"] = hist.percentile_us(0.50)
+            out[f"{base}_p99_us"] = hist.percentile_us(0.99)
+            out[f"{base}_mean_us"] = hist.mean_us
+        return out

@@ -20,6 +20,13 @@ BENCH_SECONDS=5 timeout -k 10 120 python bench.py --cluster || {
     exit "$rc"
 }
 
+echo "tier1: traced 2-node cluster smoke (sample-rate 1.0, stitched-trace gate)"
+BENCH_TRACE=1 BENCH_SECONDS=5 timeout -k 10 120 python bench.py --cluster || {
+    rc=$?
+    echo "tier1: traced cluster smoke FAILED (rc=$rc) — no stitched cross-node trace?" >&2
+    exit "$rc"
+}
+
 echo "tier1: seeded chaos soak smoke (~5 s: partition + owner crash + slow store)"
 CHAOS_MESSAGES=80 timeout -k 10 180 python bench.py --chaos --seed 42 || {
     rc=$?

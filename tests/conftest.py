@@ -78,3 +78,5 @@ def pytest_pyfunc_call(pyfuncitem):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: asyncio-based test")
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from the tier-1 gate")
