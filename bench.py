@@ -917,6 +917,44 @@ def main() -> None:
             sys.exit(1)
         return
 
+    if "--telemetry-overhead" in sys.argv:
+        # per-entity sampling cost: the headline transient/autoAck spec
+        # with telemetry off vs on at a 100 ms tick (10x the default
+        # rate). The hot path only pays the incremental gauge/counter
+        # bumps; the sampler walk runs on the timer — the claim is a
+        # <= 2% throughput delta, asserted here so tier-1 gates on it.
+        spec = "transient_autoack_3p3c"
+        runs = {}
+        for label, extra in (
+            ("off", None),
+            ("on", {"CHANAMQ_TELEMETRY_ENABLED": "true",
+                    "CHANAMQ_TELEMETRY_INTERVAL": "100ms"}),
+        ):
+            runs[label] = run_spec(spec, extra_env=extra)
+            print(f"# telemetry_overhead {label}: {runs[label]}",
+                  file=sys.stderr)
+        base = runs["off"].get("delivered_per_s") or 0
+        cur = runs["on"].get("delivered_per_s")
+        delta = (round((cur - base) / base * 100, 2)
+                 if base and cur is not None else None)
+        errors = {k: v["error"] for k, v in runs.items() if "error" in v}
+        over_budget = delta is not None and delta < -2.0
+        print(json.dumps({
+            "metric": "telemetry_overhead_pct",
+            "value": delta,
+            "unit": "%",
+            "vs_baseline": None,
+            "delivered_per_s": {
+                k: v.get("delivered_per_s") for k, v in runs.items()},
+            "body_bytes": BODY_BYTES,
+            "budget_pct": -2.0,
+            "within_budget": not over_budget,
+            **({"error": errors} if errors else {}),
+        }))
+        if errors or over_budget:
+            sys.exit(1)  # > 2% throughput loss fails the smoke
+        return
+
     if "--replicate" in sys.argv:
         # replication scenario only: factor-2 sync confirms on private
         # per-node stores (lag + confirm latency as its own BENCH line)

@@ -81,6 +81,18 @@ class Broker:
         # set by chanamq_tpu.models.service.ForecastService when forecasting
         # is on (chana.mq.forecast.enabled); admin serves its snapshot
         self.forecaster = None
+        # set by chanamq_tpu.telemetry.service.TelemetryService when
+        # per-entity sampling is on (chana.mq.telemetry.enabled)
+        self.telemetry = None
+        # broker-wide entity gauges, maintained incrementally at every queue
+        # mutation site (entities.py / streams/queue.py) so a sampler tick is
+        # O(1) instead of a walk over every queue in every vhost
+        self.queue_depth = 0
+        self.queue_unacked = 0
+        self.queue_consumers = 0
+        # readiness drain: run_node flips this when the shutdown signal
+        # lands, so /admin/health reports 503 while listeners wind down
+        self.draining = False
         self.message_sweep_interval_s = message_sweep_interval_s
         # per-queue resident watermark: beyond this depth, durable+persistent
         # bodies are paged out to the store (config chana.mq.queue.max-resident,
@@ -259,8 +271,13 @@ class Broker:
         snap["store_bytes"] = self.store_bytes
         snap["store_max_bytes"] = self.store_max_bytes
         snap["held_bytes"] = self.held_bytes
+        snap["queue_depth"] = self.queue_depth
+        snap["queue_unacked"] = self.queue_unacked
+        snap["queue_consumers"] = self.queue_consumers
         if self.cluster is not None and self.cluster.replication is not None:
             snap["repl_lag_events"] = self.cluster.replication.total_lag()
+        if self.telemetry is not None:
+            snap.update(self.telemetry.gauges())
         return snap
 
     # -- lifecycle ---------------------------------------------------------
@@ -448,6 +465,10 @@ class Broker:
             queue._passivated.extend(
                 qm for qm in ordered if qm.message.body is None)
         queue.ready_bytes = sum(q.body_size for q in queue.messages)
+        # recovery appended to queue.messages directly (bypassing push()),
+        # so credit the broker depth gauge in one bulk adjustment; recovered
+        # unacks re-entered as ready messages, so no unacked adjustment
+        self.queue_depth += len(queue.messages)
         if sq.unacks:
             # Recovered unacks re-enter the queue as ready messages. They
             # must survive a second crash, so convert the store rows:
@@ -560,6 +581,7 @@ class Broker:
         self.invalidate_routes()
         for queue in list(vhost.queues.values()):
             queue.deleted = True
+            queue.gauges_detach()
         await self.store.delete_vhost(name)
         if self.cluster is not None:
             self.cluster.broadcast_bg(
@@ -999,6 +1021,10 @@ class Broker:
         self.invalidate_routes()
         count = (queue.message_count if queue.is_stream
                  else len(queue.messages))
+        # drop the queue's contribution to the broker entity gauges before
+        # the manual consumer/message teardown below (which bypasses the
+        # incremental sites), and stop any post-delete settles double-counting
+        queue.gauges_detach()
         # unbind everywhere (reference broadcasts QueueDeleted on pub-sub);
         # auto-delete sources go through delete_exchange so e2e bindings on
         # both sides are swept and the deletion replicates cluster-wide

@@ -206,6 +206,7 @@ class ServerChannel:
                 t_del = time.perf_counter_ns()
         self.connection.send_bytes(
             self._render_deliver(consumer, tag, qm.redelivered, msg, body))
+        self.connection.delivered_msgs += 1
         metrics = self.connection.broker.metrics
         metrics.delivered(len(body))
         metrics.publish_to_deliver_us.observe_us(
@@ -306,6 +307,7 @@ class ServerChannel:
     def ack(self, delivery: Delivery) -> None:
         self.unacked.pop(delivery.delivery_tag, None)
         self._release_budget(delivery)
+        self.connection.acked_msgs += 1
         delivery.queue.ack(delivery)
         delivery.queue.schedule_dispatch()
 

@@ -154,6 +154,11 @@ class AMQPConnection:
         # client's Channel.CloseOk arrives (0-9-1 close protocol)
         self._closing_channels: set[int] = set()
         self.exclusive_queues: set[str] = set()
+        # monotonic per-connection counters: the telemetry sampler derives
+        # per-connection publish/deliver/ack rates from their deltas
+        self.published_msgs = 0
+        self.delivered_msgs = 0
+        self.acked_msgs = 0
         self.closing = False
         self.closed = asyncio.get_event_loop().create_future()
 
@@ -1480,6 +1485,7 @@ class AMQPConnection:
 
     def _arm_confirm(self, channel: ServerChannel) -> Optional[int]:
         self._has_published = True
+        self.published_msgs += 1
         if channel.mode == ChannelMode.CONFIRM:
             channel.publish_seq += 1
             return channel.publish_seq
@@ -1667,6 +1673,7 @@ class AMQPConnection:
                 exchange=msg.exchange, routing_key=msg.routing_key,
                 message_count=queue.message_count),
             msg.properties, msg.body))
+        self.delivered_msgs += 1
         self.broker.metrics.delivered(len(msg.body))
         if method.no_ack:
             self.broker.unrefer(msg)
@@ -1711,6 +1718,7 @@ class AMQPConnection:
                 exchange=message.exchange, routing_key=message.routing_key,
                 message_count=int(reply.get("message_count", 0))),
             message.properties, message.body))
+        self.delivered_msgs += 1
         self.broker.metrics.delivered(len(message.body))
         if not method.no_ack:
             ref = RemoteQueueRef(self.broker.cluster, self.vhost_name, method.queue)

@@ -225,6 +225,15 @@ class Queue:
         self.last_used = now_ms()
         # body bytes across READY messages (limit enforcement + gauge)
         self.ready_bytes = 0
+        # monotonic per-queue counters: the telemetry sampler derives
+        # per-queue publish/deliver/ack rates from their deltas
+        self.n_published = 0
+        self.n_delivered = 0
+        self.n_acked = 0
+        # whether this queue is reflected in the broker-wide entity gauges
+        # (queue_depth/queue_unacked/queue_consumers); gauges_detach()
+        # clears it at deletion so late settles cannot double-subtract
+        self._counted = True
         # replication log when this node owns a replicated queue (bound by
         # ReplicationManager.attach); every durable store mutation below
         # mirrors itself into it so followers track exactly the rows a
@@ -307,6 +316,9 @@ class Queue:
                               self.max_priority)
             self._insert_by_priority(qm)
         self.ready_bytes += qm.body_size
+        self.n_published += 1
+        if self._counted:
+            self.broker.queue_depth += 1
         if self.durable and message.persisted:
             self.broker.store.insert_queue_msg_nowait(
                 self.vhost, self.name, qm.offset, message.id,
@@ -442,6 +454,8 @@ class Queue:
             if qm is watch:
                 dropped_watch = True
             self.ready_bytes -= qm.body_size
+            if self._counted:
+                self.broker.queue_depth -= 1
             self._advance_watermark(qm)
             self._settle_dead(qm, "maxlen")
         if self._passivated:
@@ -473,6 +487,8 @@ class Queue:
                 self.messages[0].dead or self.messages[0].is_expired(now)):
             qm = self.messages.popleft()
             self.ready_bytes -= qm.body_size
+            if self._counted:
+                self.broker.queue_depth -= 1
             self._advance_watermark(qm)
             self._settle_dead(qm, "expired")
             expired = True
@@ -574,12 +590,17 @@ class Queue:
                 break
             messages.popleft()
             self.ready_bytes -= qm.body_size
+            if self._counted:
+                self.broker.queue_depth -= 1
             delivery = consumer.deliver(self, qm)
             self._advance_watermark(qm)
+            self.n_delivered += 1
             if delivery is None:  # no_ack: consumed immediately
                 self.broker.unrefer(qm.message)
             else:
                 self.outstanding[qm.offset] = delivery
+                if self._counted:
+                    self.broker.queue_unacked += 1
                 if self.durable and qm.message.persisted:
                     new_unacks.append(
                         (qm.message.id, qm.offset, qm.body_size, qm.expire_at_ms)
@@ -765,6 +786,8 @@ class Queue:
                 return None
             qm = self.messages.popleft()
             self.ready_bytes -= qm.body_size
+            if self._counted:
+                self.broker.queue_depth -= 1
             msg = qm.message
             if msg.body is None:
                 try:
@@ -772,6 +795,8 @@ class Queue:
                 except Exception:
                     self.messages.appendleft(qm)
                     self.ready_bytes += qm.body_size
+                    if self._counted:
+                        self.broker.queue_depth += 1
                     raise
                 sm = stored.get(msg.id)
                 if sm is None:  # blob gone: drop and try the next entry
@@ -786,6 +811,7 @@ class Queue:
                     msg.accounted = True
                 self._prune_passivated()  # this entry is settled now
             self._advance_watermark(qm)
+            self.n_delivered += 1
             return qm
 
     # -- ack / requeue -----------------------------------------------------
@@ -795,9 +821,13 @@ class Queue:
         Streams key this differently (cursor, offset), so callers go
         through this hook instead of writing the dict directly."""
         self.outstanding[delivery.queued.offset] = delivery
+        if self._counted:
+            self.broker.queue_unacked += 1
 
     def _settle_store(self, delivery: Delivery) -> None:
-        self.outstanding.pop(delivery.queued.offset, None)
+        popped = self.outstanding.pop(delivery.queued.offset, None)
+        if popped is not None and self._counted:
+            self.broker.queue_unacked -= 1
         if self.durable and delivery.queued.message.persisted:
             buf = self._unack_del_buf
             buf.append(delivery.queued.message.id)
@@ -806,6 +836,7 @@ class Queue:
 
     def ack(self, delivery: Delivery) -> None:
         self._settle_store(delivery)
+        self.n_acked += 1
         if trace.ACTIVE is not None:
             tr = delivery.queued.message.trace
             if tr is not None:
@@ -834,7 +865,9 @@ class Queue:
     def requeue(self, delivery: Delivery) -> None:
         """Return an unacked message to the queue, in offset order, marked
         redelivered (reference: QueueEntity.scala:415-446)."""
-        self.outstanding.pop(delivery.queued.offset, None)
+        popped = self.outstanding.pop(delivery.queued.offset, None)
+        if popped is not None and self._counted:
+            self.broker.queue_unacked -= 1
         qm = delivery.queued
         qm.redelivered = True
         if qm.is_expired():
@@ -849,6 +882,8 @@ class Queue:
             self._settle_dead(qm, "expired")
             return
         self.ready_bytes += qm.body_size
+        if self._counted:
+            self.broker.queue_depth += 1
         if self.max_priority is not None:
             # priority queues: back into the (priority desc, offset) order;
             # durably, the dispatch deleted this entry's row, so settle the
@@ -913,6 +948,8 @@ class Queue:
         for qm in self.messages:
             self._advance_watermark(qm)
             self.broker.unrefer(qm.message)
+        if self._counted:
+            self.broker.queue_depth -= len(self.messages)
         self.messages.clear()
         self.ready_bytes = 0
         self._passivated.clear()
@@ -929,6 +966,8 @@ class Queue:
 
     def add_consumer(self, consumer: "Consumer") -> None:
         self.consumers.append(consumer)
+        if self._counted:
+            self.broker.queue_consumers += 1
         if self._prio_groups is not None or getattr(consumer, "priority", 0):
             self._rebuild_prio_groups()
         self.had_consumer = True
@@ -942,6 +981,8 @@ class Queue:
             self.consumers.remove(consumer)
         except ValueError:
             return False
+        if self._counted:
+            self.broker.queue_consumers -= 1
         if self._prio_groups is not None:
             self._rebuild_prio_groups()
         if self.single_active and self.consumers:
@@ -952,6 +993,19 @@ class Queue:
         if self.auto_delete and self.had_consumer and not self.consumers:
             return True
         return False
+
+    def gauges_detach(self) -> None:
+        """Remove this queue's contribution from the broker-wide entity
+        gauges (queue/vhost deletion paths tear down messages/consumers
+        directly, bypassing the incremental sites above). Idempotent: a
+        settle arriving after deletion must not double-subtract."""
+        if not self._counted:
+            return
+        self._counted = False
+        broker = self.broker
+        broker.queue_depth -= len(self.messages)
+        broker.queue_unacked -= len(self.outstanding)
+        broker.queue_consumers -= len(self.consumers)
 
 
 class Exchange:

@@ -294,6 +294,7 @@ async def run_node(config) -> None:
     admin = None
     cluster = None
     forecaster = None
+    telemetry = None
     started = False
     stop_event = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -365,6 +366,39 @@ async def run_node(config) -> None:
             # seeds): don't open listeners just to tear clients down
             return
         await server.start_listeners()
+        if config.bool("chana.mq.telemetry.enabled"):
+            # per-entity telemetry + health + alerts (telemetry/): started
+            # after the cluster layer so the first tick already sees the
+            # real node name and replication state
+            from ..telemetry import TelemetryService, default_rules
+
+            telemetry = TelemetryService(
+                server.broker,
+                interval_s=config.duration_s("chana.mq.telemetry.interval")
+                or 1.0,
+                ring_ticks=config.int("chana.mq.telemetry.ring-ticks"),
+                max_queues=config.int("chana.mq.telemetry.max-queues"),
+                max_connections=config.int(
+                    "chana.mq.telemetry.max-connections"),
+                top_k=config.int("chana.mq.telemetry.top-k"),
+                rules=default_rules(
+                    backlog_growth=float(
+                        config.int("chana.mq.alerts.backlog-growth")),
+                    backlog_window=config.int("chana.mq.alerts.backlog-window"),
+                    stall_ticks=config.int("chana.mq.alerts.stall-ticks"),
+                    repl_lag=float(config.int("chana.mq.alerts.repl-lag")),
+                    loop_lag_ms=float(
+                        config.int("chana.mq.alerts.loop-lag-ms")),
+                ),
+                alerts_enabled=config.bool("chana.mq.alerts.enabled"),
+                loop_lag_ready_ms=float(
+                    config.int("chana.mq.telemetry.ready-loop-lag-ms")),
+                repl_lag_ready=config.int("chana.mq.telemetry.ready-repl-lag"),
+                store_error_window=config.int(
+                    "chana.mq.telemetry.store-error-window"),
+            )
+            server.broker.telemetry = telemetry
+            await telemetry.start()
         if config.bool("chana.mq.forecast.enabled"):
             # live-telemetry forecaster (SURVEY.md §7.1's JAX role): samples
             # metrics on the loop, trains/predicts on a worker thread,
@@ -392,6 +426,9 @@ async def run_node(config) -> None:
                     "chana.mq.forecast.train-interval") or 30.0,
                 seq_len=config.int("chana.mq.forecast.window"),
                 history=config.int("chana.mq.forecast.history"),
+                queue_top_k=(
+                    config.int("chana.mq.forecast.queue-top-k")
+                    if telemetry is not None else 0),
             )
             await forecaster.start()
         if config.bool("chana.mq.admin.enabled"):
@@ -402,10 +439,18 @@ async def run_node(config) -> None:
             )
             await admin.start()
         await stop_event.wait()
+        # readiness flips 503 the moment the drain starts — the admin
+        # server is still up below, so a load balancer polling
+        # /admin/health stops routing to this node before connections
+        # actually tear down
+        server.broker.draining = True
         log.info("shutdown signal received; draining")
     finally:
+        server.broker.draining = True
         if admin:
             await admin.stop()
+        if telemetry:
+            await telemetry.stop()
         if forecaster:
             await forecaster.stop()
         if cluster:

@@ -17,6 +17,13 @@ smoke and the test suite assert the same invariants:
 5. **Reconnect stays inside the backoff budget** — the publisher finishes
    every message despite injected disconnects/partitions, and no stream's
    backoff delay ever exceeds the configured ceiling.
+6. **Health gates and alerts are deterministic** — both nodes must report
+   ready (telemetry/health.py) before any load is offered, and a scripted
+   backlog + stalled-consumer phase on the surviving node must fire
+   exactly the expected alert rules: the telemetry services are
+   tick-driven by the harness (no timers), so the alert engine sees the
+   same series every run and the firing set is exact, like the fault
+   schedule itself.
 
 Topology: nodes A and B with private MemoryStores, replicate factor 2,
 sync confirms. Queue ``rq`` is owned by A but published AND consumed via
@@ -82,6 +89,8 @@ async def run_soak(
     from ..store.memory import MemoryStore
     from ..broker.server import BrokerServer
     from ..cluster.node import ClusterNode
+    from ..telemetry import TelemetryService
+    from ..telemetry.alerts import default_rules as alert_defaults
 
     async def start_node(seeds):
         srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
@@ -92,6 +101,16 @@ async def run_soak(
                          replicate_factor=2, replicate_sync=True,
                          replicate_ack_timeout_ms=2000)
         await cl.start()
+        # tick-driven telemetry: the harness calls sample_tick at scripted
+        # points instead of starting the timer task, so the alert engine's
+        # input series — and therefore its firings — are exact. Node-scoped
+        # rules get unreachable thresholds (loop lag and replication lag
+        # depend on host timing, which would make firings flaky).
+        srv.broker.telemetry = TelemetryService(
+            srv.broker, interval_s=1.0, ring_ticks=64,
+            rules=alert_defaults(
+                backlog_growth=50.0, backlog_window=5, stall_ticks=3,
+                repl_lag=1e12, loop_lag_ms=1e12))
         return srv, cl
 
     a_srv = a_cl = b_srv = b_cl = None
@@ -107,6 +126,17 @@ async def run_soak(
             await asyncio.sleep(0.05)
         else:
             raise RuntimeError("2-node membership did not converge")
+
+        # -- health gate (invariant 6a): both nodes ready before any load
+        health_gate: dict[str, bool] = {}
+        for srv, cl in ((a_srv, a_cl), (b_srv, b_cl)):
+            srv.broker.telemetry.sample_tick(1.0)
+            health = srv.broker.telemetry.health()
+            health_gate[cl.name] = health["ready"]
+            if not health["ready"]:
+                violations.append(
+                    f"health gate: {cl.name} not ready before load: "
+                    f"{health['reasons']}")
 
         rq = next(f"cq{i}" for i in range(200)
                   if a_cl.queue_owner("/", f"cq{i}") == a_cl.name)
@@ -284,6 +314,9 @@ async def run_soak(
         stream = await _stream_cursor_check(
             b_srv, sq, stream_records, violations)
 
+        # -- deterministic alert firings (invariant 6b) on the survivor
+        alerts = await _alert_phase(b_srv, b_cl, violations)
+
         return {
             "seed": seed,
             "fingerprint": fingerprint,
@@ -297,6 +330,8 @@ async def run_soak(
             "crashed": crashed.is_set(),
             "max_backoff_s": round(max_backoff_seen, 3),
             "stream": stream,
+            "health_gate": health_gate,
+            "alerts": alerts,
             "chaos": runtime.status(),
             "violations": violations,
         }
@@ -313,6 +348,71 @@ async def run_soak(
                     await part.stop()
                 except Exception:
                     pass
+
+
+# the scripted alert phase must fire exactly these rules, every run
+EXPECTED_ALERT_RULES = ("backlog-growth", "consumer-stall")
+
+
+async def _alert_phase(srv, cl, violations: list[str]) -> dict:
+    """Invariant 6b: drive the surviving node's telemetry through a
+    scripted backlog (publish with no consumer -> backlog-growth) and a
+    stalled consumer (prefetch 1, never acks -> consumer-stall), ticking
+    the sampler by hand. The engine's input is then a pure function of
+    the workload, so the set of fired rules must match
+    EXPECTED_ALERT_RULES exactly — no more, no fewer."""
+    from ..client.client import AMQPClient
+
+    svc = srv.broker.telemetry
+    aq = next(f"ca{i}" for i in range(200)
+              if cl.queue_owner("/", f"ca{i}") == cl.name)
+    conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    try:
+        ch = await conn.channel()
+        await ch.confirm_select()
+        await ch.queue_declare(aq)
+        # baseline tick: the queue's ring slot needs one pre-backlog
+        # sample for the growth window to measure against
+        svc.sample_tick(1.0)
+        for i in range(120):
+            ch.basic_publish(f"a{i:04d}".encode(), routing_key=aq)
+        await ch.wait_unconfirmed_below(1, timeout=15)
+        # two post-backlog ticks: +120 depth inside the 5-tick window on
+        # both -> breach streak reaches for_ticks=2 -> backlog-growth fires
+        svc.sample_tick(1.0)
+        svc.sample_tick(1.0)
+
+        # stalled consumer: prefetch 1, never acks. The first delivery
+        # lands before the next tick (deliver_rate blips once), then the
+        # queue has depth > 0, consumers > 0 and zero deliver rate for
+        # stall_ticks=3 straight ticks -> consumer-stall fires
+        first = asyncio.Event()
+        await ch.basic_qos(prefetch_count=1)
+        await ch.basic_consume(aq, lambda msg: first.set(),
+                               consumer_tag="stalled")
+        await asyncio.wait_for(first.wait(), 10)
+        for _ in range(4):
+            svc.sample_tick(1.0)
+
+        snapshot = svc.engine.snapshot()
+        fired = tuple(snapshot["fired_rules"])
+        if fired != EXPECTED_ALERT_RULES:
+            violations.append(
+                f"alert firings not exact: expected {EXPECTED_ALERT_RULES}, "
+                f"got {fired}")
+        return {
+            "queue": aq,
+            "fired_rules": list(fired),
+            "fired_total": snapshot["fired_total"],
+            "resolved_total": snapshot["resolved_total"],
+            "firing_now": [
+                f"{i['rule']}:{i['entity']}" for i in snapshot["firing"]],
+        }
+    finally:
+        try:
+            await conn.close()
+        except Exception:
+            pass
 
 
 async def _stream_cursor_check(

@@ -262,6 +262,7 @@ class ClusterNode:
                                     -len(msg.body or b""))
                                 msg.accounted = False
                         queue.deleted = True
+                        queue.gauges_detach()
                         del vhost.queues[name]
                         continue
                     if queue.consumers or queue.messages or queue.outstanding:
@@ -285,6 +286,7 @@ class ClusterNode:
                         continue
                     # idle local shell under a live foreign holder
                     queue.deleted = True
+                    queue.gauges_detach()
                     del vhost.queues[name]
                     continue
                 # no live foreign holder. Evaluate placement BEFORE any
@@ -297,6 +299,7 @@ class ClusterNode:
                 if not ring_owned and not live:
                     # idle shell owned elsewhere by the ring: hand off
                     queue.deleted = True
+                    queue.gauges_detach()
                     del vhost.queues[name]
                     if self.replication is not None:
                         # close (not delete) the outgoing log: the next
@@ -390,6 +393,8 @@ class ClusterNode:
                     if isinstance(consumer, RemoteConsumer) and consumer.origin == origin:
                         consumer.requeue_outstanding()
                         queue.consumers.remove(consumer)
+                        if queue._counted:
+                            self.broker.queue_consumers -= 1
 
     _reconcile_retry_pending = False
 
@@ -514,6 +519,7 @@ class ClusterNode:
         rpc.register("consumer.deliver_many", self._h_consumer_deliver_many)
         rpc.register("consumer.credit", self._h_consumer_credit)
         rpc.register("consumer.cancelled", self._h_consumer_cancelled)
+        rpc.register("telemetry.pull", self._h_telemetry_pull)
         # data plane: binary zero-copy bodies, no field-table codec
         rpc.register_binary(dp.METHOD_PUSH_MANY, self._hb_push_many)
         rpc.register_binary(dp.METHOD_SETTLE_MANY, self._hb_settle_many)
@@ -667,6 +673,7 @@ class ClusterNode:
             queue = vhost.queues.get(name)
             if queue is not None:
                 queue.deleted = True
+                queue.gauges_detach()
                 del vhost.queues[name]
             return {}
         return {}
@@ -992,6 +999,8 @@ class ClusterNode:
 
             delivery = Delivery(qm, queue, None, "", 0, no_ack=False)  # type: ignore[arg-type]
             queue.outstanding[qm.offset] = delivery
+            if queue._counted:
+                self.broker.queue_unacked += 1
             if queue.durable and msg.persisted:
                 self.broker.store_bg(self.broker.store.insert_queue_unacks(
                     queue.vhost, queue.name,
@@ -1010,6 +1019,8 @@ class ClusterNode:
             if isinstance(consumer, RemoteConsumer) and consumer.tag == tag \
                     and consumer.origin == origin:
                 queue.consumers.remove(consumer)
+                if queue._counted:
+                    self.broker.queue_consumers -= 1
         consumer = RemoteConsumer(
             self, tag, queue, bool(payload.get("no_ack")), origin,
             int(payload.get("credit", DEFAULT_CREDIT)),
@@ -1267,6 +1278,16 @@ class ClusterNode:
             channel.consumers.pop(key[2], None)
             channel.connection.notify_consumer_cancel(channel, key[2])
         return {}
+
+    async def _h_telemetry_pull(self, payload: dict) -> dict:
+        """Serve this node's telemetry snapshot to a peer aggregating the
+        cluster view (any node's /admin/timeseries|health|alerts)."""
+        svc = self.broker.telemetry
+        if svc is None:
+            return {"node": self.name, "error": "telemetry disabled"}
+        window = max(1, min(int(payload.get("window", 60)), 4096))
+        top = max(0, int(payload.get("top", 0)))
+        return svc.local_payload(window, top)
 
     async def remote_cancel(self, vhost: str, name: str, tag: str) -> None:
         info = self._remote_consumers.pop((vhost, name, tag), None)

@@ -101,6 +101,34 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.forecast.train-interval": "30s",
     "chana.mq.forecast.window": 64,     # telemetry vectors per model input
     "chana.mq.forecast.history": 4096,  # ring capacity (vectors retained)
+    # per-queue forecaster awareness: widen the feature vector with
+    # (depth, publish_rate) of the K busiest queues from the per-entity
+    # telemetry rings. 0 = node-total features only; >0 requires
+    # chana.mq.telemetry.enabled.
+    "chana.mq.forecast.queue-top-k": 0,
+    # per-entity telemetry (telemetry/): fixed-slot timeseries ring per
+    # queue and per connection, sampled off the hot path each interval;
+    # event-loop lag + sampler saturation probes; /admin/timeseries,
+    # /admin/health (readiness with reasons), /admin/alerts
+    "chana.mq.telemetry.enabled": False,
+    "chana.mq.telemetry.interval": "1s",
+    "chana.mq.telemetry.ring-ticks": 120,      # history per entity
+    "chana.mq.telemetry.max-queues": 512,      # entity slots (fixed memory)
+    "chana.mq.telemetry.max-connections": 256,
+    "chana.mq.telemetry.top-k": 8,             # default top-K summary size
+    # readiness thresholds (/admin/health flips 503 past these)
+    "chana.mq.telemetry.ready-loop-lag-ms": 1000,
+    "chana.mq.telemetry.ready-repl-lag": 10000,
+    "chana.mq.telemetry.store-error-window": 30,  # ticks
+    # declarative alert rules evaluated over the per-entity matrix each
+    # tick (telemetry/alerts.py): thresholds for the four built-ins;
+    # hysteresis is tick-counted inside the rules
+    "chana.mq.alerts.enabled": True,   # gates evaluation, not sampling
+    "chana.mq.alerts.backlog-growth": 100,   # ready msgs gained per window
+    "chana.mq.alerts.backlog-window": 5,     # growth lookback, ticks
+    "chana.mq.alerts.stall-ticks": 3,        # zero-deliver ticks -> stall
+    "chana.mq.alerts.repl-lag": 1000,        # events behind
+    "chana.mq.alerts.loop-lag-ms": 250,      # event-loop lag
     "chana.mq.cluster.enabled": False,
     "chana.mq.cluster.host": "127.0.0.1",
     "chana.mq.cluster.port": 25672,

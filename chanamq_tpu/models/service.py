@@ -57,6 +57,7 @@ class ForecastService:
         batch: int = 16,
         steps_per_round: int = 20,
         lr: float = 1e-3,
+        queue_top_k: int = 0,
         model_kwargs: Optional[dict[str, Any]] = None,
     ) -> None:
         self.broker = broker
@@ -64,6 +65,17 @@ class ForecastService:
         self.train_interval_s = train_interval_s
         self.seq_len = seq_len
         self.batch = batch
+        # per-queue awareness: widen each sample with (depth, publish_rate)
+        # of the K busiest queues from the per-entity telemetry rings
+        # (broker.telemetry). Slot columns are rank-ordered ("the busiest
+        # queue"), not name-bound, so the feature space stays fixed-width
+        # as queues come and go. Zeros when telemetry is off.
+        self.queue_top_k = queue_top_k
+        self.feature_names: tuple[str, ...] = FEATURES + tuple(
+            name
+            for i in range(queue_top_k)
+            for name in (f"top{i}_depth", f"top{i}_publish_rate"))
+        self.n_features = len(self.feature_names)
         self.steps_per_round = steps_per_round
         self.lr = lr
         # compact model by default: 8 features need nowhere near the
@@ -79,7 +91,7 @@ class ForecastService:
             raise ValueError(
                 f"forecast history ({history}) must exceed window "
                 f"({seq_len}) — the ring must hold window+1 vectors")
-        self.ring = TelemetryRing(history)
+        self.ring = TelemetryRing(history, width=self.n_features)
         self._task: Optional[asyncio.Task] = None
         # one worker: params live on this thread, rounds never overlap
         self._executor = concurrent.futures.ThreadPoolExecutor(
@@ -136,6 +148,13 @@ class ForecastService:
                 now = time.monotonic()
                 vec, counters = sample(self.broker, counters, now - last)
                 last = now
+                if self.queue_top_k:
+                    telemetry = getattr(self.broker, "telemetry", None)
+                    extra = (
+                        telemetry.topk_features(self.queue_top_k)
+                        if telemetry is not None
+                        else np.zeros(2 * self.queue_top_k, dtype=np.float32))
+                    vec = np.concatenate([vec, extra])
                 self.ring.push(vec)
                 if (now >= next_train and not self._round_inflight
                         and len(self.ring) >= self.seq_len + 1):
@@ -189,7 +208,9 @@ class ForecastService:
             make_train_step,
         )
 
-        cfg = ForecasterConfig(seq_len=self.seq_len, **self.model_kwargs)
+        cfg = ForecasterConfig(
+            n_features=self.n_features, seq_len=self.seq_len,
+            **self.model_kwargs)
         params = init_params(jax.random.PRNGKey(0), cfg)
         state = {
             "cfg": cfg,
@@ -235,7 +256,8 @@ class ForecastService:
         real = pred * std + mean
         # rates/gauges cannot be negative; the model can briefly overshoot
         real = np.maximum(real, 0.0)
-        forecast = {name: float(v) for name, v in zip(FEATURES, real)}
+        forecast = {name: float(v)
+                    for name, v in zip(self.feature_names, real)}
         return steps, loss, forecast
 
     # -- introspection (admin API) -----------------------------------------
@@ -250,8 +272,10 @@ class ForecastService:
             "rounds": self.rounds,
             "trained_steps": self.trained_steps,
             "loss": self.loss,
+            "queue_top_k": self.queue_top_k,
             "observed": (
-                {name: float(v) for name, v in zip(FEATURES, observed)}
+                {name: float(v)
+                 for name, v in zip(self.feature_names, observed)}
                 if observed is not None else None),
             "forecast": self.forecast,
             "updated_at": self.updated_at,

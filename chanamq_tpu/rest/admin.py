@@ -17,12 +17,36 @@ import asyncio
 import json
 import logging
 from typing import Optional
-from urllib.parse import unquote
+from urllib.parse import parse_qs, unquote
 
 from ..broker.broker import Broker
 from ..store.api import is_replica_vhost
 
 log = logging.getLogger("chanamq.admin")
+
+
+class AdminError(Exception):
+    """An expected, client-facing request failure: carries the HTTP status
+    and a stable message. Anything else that escapes a handler is an
+    internal error — logged with traceback server-side, reported to the
+    client as an opaque 500 (raw exception text leaks paths, queue names
+    and implementation detail to anything that can reach the port)."""
+
+    def __init__(self, status: str, message: str) -> None:
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class _Response:
+    """Handler return wrapper for non-200 success-path statuses (the
+    readiness probe answers 503 with a perfectly well-formed body)."""
+
+    __slots__ = ("status", "payload")
+
+    def __init__(self, status: str, payload: object) -> None:
+        self.status = status
+        self.payload = payload
 
 
 class AdminServer:
@@ -106,8 +130,10 @@ class AdminServer:
     async def _route(
         self, method: str, path: str, body: bytes = b""
     ) -> tuple[str, object]:
+        path, _, qs = path.partition("?")
+        query = {k: v[-1] for k, v in parse_qs(qs).items()}
         segments = [unquote(s) for s in path.strip("/").split("/") if s]
-        matched = self._match(segments, body)
+        matched = self._match(segments, body, query)
         if matched is None:
             # unknown path: 404 regardless of verb
             return "404 Not Found", {"error": "unknown path"}
@@ -121,16 +147,24 @@ class AdminServer:
             result = handler()
             if asyncio.iscoroutine(result):
                 result = await result
+            if isinstance(result, _Response):
+                return result.status, result.payload
             return "200 OK", result
-        except Exception as exc:
-            return "500 Internal Server Error", {"error": str(exc)}
+        except AdminError as exc:
+            return exc.status, {"error": exc.message}
+        except Exception:
+            # stable opaque shape to the client, full traceback in the log
+            log.exception("admin handler failed: %s %s", allowed,
+                          "/" + "/".join(segments))
+            return "500 Internal Server Error", {"error": "internal error"}
 
-    def _match(self, segments: list, body: bytes = b""):
+    def _match(self, segments: list, body: bytes = b"", query: dict = None):
         """Resolve a path to (allowed_method, handler) or None. Handlers
         may be sync or async; mutations require POST (a GET mutation is
         CSRF-triggerable from any web page even on localhost), reads GET.
         Paths mirror the reference's AdminApi plus the observability
         endpoints it lacked."""
+        query = query or {}
         if segments == ["metrics"]:
             # conventional Prometheus scrape path (text exposition format)
             return ("GET", self._prometheus)
@@ -170,7 +204,166 @@ class AdminServer:
             return ("GET", self._traces)
         if len(rest) == 2 and rest[0] == "traces":
             return ("GET", lambda: self._trace_detail(rest[1]))
+        if rest == ["timeseries"]:
+            return ("GET", lambda: self._timeseries(query))
+        if len(rest) == 4 and rest[:2] == ["timeseries", "queue"]:
+            return ("GET", lambda: self._timeseries_queue(
+                rest[2], rest[3], query))
+        if len(rest) == 3 and rest[:2] == ["timeseries", "connection"]:
+            return ("GET", lambda: self._timeseries_conn(rest[2], query))
+        if rest == ["health"]:
+            return ("GET", lambda: self._health(query))
+        if rest == ["health", "live"]:
+            return ("GET", lambda: {"live": True})
+        if rest == ["alerts"]:
+            return ("GET", lambda: self._alerts(query))
         return None
+
+    @staticmethod
+    def _q_int(query: dict, key: str, default: int, lo: int, hi: int) -> int:
+        try:
+            return max(lo, min(int(query.get(key, default)), hi))
+        except (TypeError, ValueError):
+            raise AdminError("400 Bad Request",
+                             f"query parameter {key!r} must be an integer")
+
+    # -- per-entity telemetry (chanamq_tpu/telemetry/) ----------------------
+
+    def _svc(self):
+        svc = getattr(self.broker, "telemetry", None)
+        if svc is None:
+            raise AdminError(
+                "409 Conflict",
+                "telemetry disabled: boot with chana.mq.telemetry.enabled")
+        return svc
+
+    async def _timeseries(self, query: dict) -> dict:
+        """Cluster-wide per-entity series: every alive node's payload plus
+        a merged top-K-by-rate summary. ?window=N ticks, ?top=K queues per
+        node (0 = all), ?scope=local skips the peer pull."""
+        svc = self._svc()
+        window = self._q_int(query, "window", 60, 1, 4096)
+        top = self._q_int(query, "top", 0, 0, 1024)
+        if query.get("scope") == "local":
+            nodes = {self.broker.trace_node: svc.local_payload(window, top)}
+            out = {"nodes": nodes, "origin": self.broker.trace_node}
+        else:
+            out = await svc.cluster_payload(window, top)
+        out["top_queues"] = self._merge_top(
+            out["nodes"], top or 8)
+        return out
+
+    @staticmethod
+    def _merge_top(nodes: dict, k: int) -> list:
+        """Cluster-wide top-K queues by publish+deliver rate, from the
+        newest vector of each queue series in each node payload."""
+        rows = []
+        for node, payload in nodes.items():
+            fields = payload.get("fields", {}).get("queue")
+            if not fields:
+                continue  # peer errored or telemetry disabled there
+            for entry in payload.get("queues", []):
+                series = entry.get("series") or []
+                if not series:
+                    continue
+                latest = dict(zip(fields, series[-1]))
+                rate = (latest.get("publish_rate", 0.0)
+                        + latest.get("deliver_rate", 0.0))
+                rows.append({"node": node, "vhost": entry["vhost"],
+                             "name": entry["name"], "rate": rate, **latest})
+        rows.sort(key=lambda r: (-r["rate"], r["node"], r["vhost"], r["name"]))
+        return rows[:k]
+
+    async def _timeseries_queue(
+        self, vhost: str, name: str, query: dict
+    ) -> dict:
+        """Single-queue drilldown; searches peers when the queue is not
+        sampled locally (it lives on its owner node)."""
+        svc = self._svc()
+        window = self._q_int(query, "window", 120, 1, 4096)
+        series = svc.queues.series((vhost, name), window)
+        if series is not None:
+            return {"node": self.broker.trace_node, "vhost": vhost,
+                    "name": name, "fields": list(svc.queues.fields),
+                    "series": series.tolist()}
+        payload = await svc.cluster_payload(window)
+        for node, node_payload in payload["nodes"].items():
+            for entry in node_payload.get("queues", []):
+                if entry["vhost"] == vhost and entry["name"] == name:
+                    return {"node": node, "vhost": vhost, "name": name,
+                            "fields": node_payload["fields"]["queue"],
+                            "series": entry["series"]}
+        raise AdminError("404 Not Found",
+                         f"no telemetry for queue {vhost}/{name}")
+
+    async def _timeseries_conn(self, conn_id: str, query: dict) -> dict:
+        svc = self._svc()
+        window = self._q_int(query, "window", 120, 1, 4096)
+        try:
+            key = int(conn_id)
+        except ValueError:
+            raise AdminError("400 Bad Request", "connection id must be an integer")
+        series = svc.conns.series(key, window)
+        if series is not None:
+            return {"node": self.broker.trace_node, "id": key,
+                    "fields": list(svc.conns.fields),
+                    "series": series.tolist()}
+        payload = await svc.cluster_payload(window)
+        for node, node_payload in payload["nodes"].items():
+            for entry in node_payload.get("connections", []):
+                if entry["id"] == key:
+                    return {"node": node, "id": key,
+                            "fields": node_payload["fields"]["connection"],
+                            "series": entry["series"]}
+        raise AdminError("404 Not Found", f"no telemetry for connection {key}")
+
+    async def _health(self, query: dict):
+        """Readiness probe: 200 when ready, 503 with reasons when not —
+        pointable straight at a load balancer. Works without telemetry
+        (drain check only); ?scope=cluster adds every peer's verdict."""
+        svc = getattr(self.broker, "telemetry", None)
+        if svc is not None:
+            out = svc.health()
+        else:
+            draining = bool(getattr(self.broker, "draining", False))
+            out = {"node": self.broker.trace_node, "live": True,
+                   "ready": not draining,
+                   "reasons": (["draining: shutdown in progress"]
+                               if draining else []),
+                   "checks": {"draining": {"ok": not draining}}}
+        if query.get("scope") == "cluster" and svc is not None:
+            payload = await svc.cluster_payload(1)
+            out["cluster"] = {
+                node: node_payload.get(
+                    "health", {"error": node_payload.get("error", "no data")})
+                for node, node_payload in payload["nodes"].items()
+            }
+        if not out["ready"]:
+            return _Response("503 Service Unavailable", out)
+        return out
+
+    async def _alerts(self, query: dict) -> dict:
+        """Alert rules + firing state, cluster-wide by default (every
+        node evaluates its own entities; the union is the operator's
+        pager view). ?scope=local skips the peer pull."""
+        svc = self._svc()
+        out = {"node": self.broker.trace_node, **svc.engine.snapshot()}
+        if query.get("scope") != "local":
+            payload = await svc.cluster_payload(1)
+            out["cluster"] = {}
+            for node, node_payload in payload["nodes"].items():
+                alerts = node_payload.get("alerts")
+                if alerts is None:
+                    out["cluster"][node] = {
+                        "error": node_payload.get("error", "no data")}
+                else:
+                    out["cluster"][node] = {
+                        "firing": alerts["firing"],
+                        "fired_total": alerts["fired_total"],
+                        "resolved_total": alerts["resolved_total"],
+                        "fired_rules": alerts["fired_rules"],
+                    }
+        return out
 
     # -- message tracing (chanamq_tpu/trace/) ------------------------------
 
@@ -201,10 +394,11 @@ class AdminServer:
 
         runtime = trace.ACTIVE
         if runtime is None:
-            raise RuntimeError("tracing not installed")
+            raise AdminError("409 Conflict", "tracing not installed")
         found = runtime.find(trace_id)
         if found is None:
-            raise RuntimeError(f"no trace {trace_id!r} in the rings")
+            raise AdminError("404 Not Found",
+                             f"no trace {trace_id!r} in the rings")
         out = found.to_dict()
         out["finished"] = found.finished
         return out
@@ -227,9 +421,13 @@ class AdminServer:
         from .. import chaos
 
         if not getattr(self.broker, "chaos_enabled", False):
-            raise RuntimeError(
+            raise AdminError(
+                "409 Conflict",
                 "chaos disabled: boot with chana.mq.chaos.enabled")
-        plan = chaos.FaultPlan.from_dict(json.loads(body or b"{}"))
+        try:
+            plan = chaos.FaultPlan.from_dict(json.loads(body or b"{}"))
+        except (ValueError, KeyError, TypeError) as exc:
+            raise AdminError("400 Bad Request", f"bad plan: {exc}")
         chaos.install(plan, metrics=self.broker.metrics)
         return {
             "ok": True,
@@ -280,6 +478,9 @@ class AdminServer:
         "trace_sampled", "trace_completed", "trace_slow",
         "trace_chaos_tagged", "trace_ctx_sent", "trace_ctx_recv",
         "trace_evicted",
+        "telemetry_ticks", "telemetry_saturated_ticks",
+        "telemetry_evicted_entities", "telemetry_dropped_entities",
+        "alerts_fired", "alerts_resolved",
     })
 
     @staticmethod
@@ -356,6 +557,20 @@ class AdminServer:
                     out.append(
                         f"chanamq_stream_cursor_lag{clabels} "
                         f"{queue.cursor_lag(cursor)}")
+        telemetry = getattr(self.broker, "telemetry", None)
+        if telemetry is not None and telemetry.engine.firing:
+            # one series per firing alert instance, value 1 while firing;
+            # the instance disappears from the scrape on resolve (the
+            # standard ALERTS{...}-style shape, minus Prometheus itself)
+            out.append("# TYPE chanamq_alert_firing gauge")
+            for info in sorted(telemetry.engine.firing.values(),
+                               key=lambda i: (i["rule"], i["entity"])):
+                labels = (
+                    f'{{rule="{self._prom_label(info["rule"])}",'
+                    f'scope="{self._prom_label(info["scope"])}",'
+                    f'entity="{self._prom_label(info["entity"])}",'
+                    f'severity="{self._prom_label(info["severity"])}"}}')
+                out.append(f"chanamq_alert_firing{labels} 1")
         forecaster = getattr(self.broker, "forecaster", None)
         if forecaster is not None and forecaster.forecast is not None:
             # next-tick telemetry forecast (models/service.py): one gauge

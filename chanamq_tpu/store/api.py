@@ -151,6 +151,9 @@ class StoreService:
     def _fire_done(self, task) -> None:
         self._fired_tasks.discard(task)
         if not task.cancelled() and task.exception():
+            # error_count feeds the health readiness check (telemetry/):
+            # a store that is failing background writes is not ready
+            self.error_count = getattr(self, "error_count", 0) + 1
             log.error("background store write failed: %r", task.exception())
 
     async def drain_nowait(self) -> None:

@@ -201,6 +201,9 @@ class StreamQueue(Queue):
         metrics = self.broker.metrics
         metrics.stream_appends += 1
         metrics.stream_append_bytes += size
+        # per-queue rate counter only: a stream append never contributes to
+        # the broker depth gauge (records retire by retention, not consume)
+        self.n_published += 1
         if (self._active_bytes >= self.segment_bytes
                 or (self.segment_age_ms
                     and ts - self._active_first_ts >= self.segment_age_ms)):
@@ -374,6 +377,7 @@ class StreamQueue(Queue):
                                    None, body_size=len(rec.body))
                 delivery = consumer.deliver(self, qm)
                 metrics.stream_records_delivered += 1
+                self.n_delivered += 1
                 cursor.next = rec.offset + 1
                 delivered += 1
                 if delivery is None:  # no_ack: consumed + committed now
@@ -381,6 +385,8 @@ class StreamQueue(Queue):
                     self.broker.unrefer(qm.message)
                 else:
                     self.outstanding[(cursor.name, rec.offset)] = delivery
+                    if self._counted:
+                        self.broker.queue_unacked += 1
             if delivered >= self.delivery_batch:
                 more = True  # budget exhausted, not credit: keep going
         if more:
@@ -420,10 +426,15 @@ class StreamQueue(Queue):
         self.outstanding[
             (delivery.consumer_tag or GET_CURSOR,
              delivery.queued.offset)] = delivery
+        if self._counted:
+            self.broker.queue_unacked += 1
 
     def ack(self, delivery: Delivery) -> None:  # type: ignore[override]
         name = delivery.consumer_tag or GET_CURSOR
-        self.outstanding.pop((name, delivery.queued.offset), None)
+        popped = self.outstanding.pop((name, delivery.queued.offset), None)
+        if popped is not None and self._counted:
+            self.broker.queue_unacked -= 1
+        self.n_acked += 1
         self._commit(name, delivery.queued.offset)
         self.broker.unrefer(delivery.queued.message)
 
@@ -437,7 +448,9 @@ class StreamQueue(Queue):
         the record stays uncommitted, and a still-attached cursor rewinds
         to redeliver it."""
         name = delivery.consumer_tag or GET_CURSOR
-        self.outstanding.pop((name, delivery.queued.offset), None)
+        popped = self.outstanding.pop((name, delivery.queued.offset), None)
+        if popped is not None and self._counted:
+            self.broker.queue_unacked -= 1
         cursor = self._cursors.get(name)
         if cursor is not None and delivery.queued.offset < cursor.next:
             cursor.next = delivery.queued.offset
@@ -473,6 +486,7 @@ class StreamQueue(Queue):
             return None
         self._get_pos = pos + 1
         self.broker.metrics.stream_records_delivered += 1
+        self.n_delivered += 1
         return QueuedMessage(self._record_message(rec, decode_props=True),
                              rec.offset, None, body_size=len(rec.body))
 

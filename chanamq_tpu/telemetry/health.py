@@ -1,0 +1,78 @@
+"""Liveness / readiness evaluation with reasons.
+
+Liveness is trivially true whenever the process can serve the request
+(the event loop is running). Readiness is the load-balancer signal: a
+node that is draining, whose event loop is lagging, whose store is
+failing background writes, whose replication is far behind, or that has
+lost cluster quorum should stop receiving new work — each check
+contributes a human-readable reason so /admin/health explains *why*.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..broker.broker import Broker
+    from .service import TelemetryService
+
+
+def evaluate_health(broker: "Broker", svc: "TelemetryService") -> dict:
+    reasons: list[str] = []
+    checks: dict[str, dict] = {}
+
+    draining = bool(getattr(broker, "draining", False))
+    checks["draining"] = {"ok": not draining}
+    if draining:
+        reasons.append("draining: shutdown in progress")
+
+    lag_ms = svc.loop_lag_ms
+    lag_ok = lag_ms <= svc.loop_lag_ready_ms
+    checks["loop_lag"] = {
+        "ok": lag_ok, "lag_ms": round(lag_ms, 3),
+        "threshold_ms": svc.loop_lag_ready_ms}
+    if not lag_ok:
+        reasons.append(
+            f"event-loop lag {lag_ms:.0f}ms > {svc.loop_lag_ready_ms:.0f}ms")
+
+    # store errors: not-ready while background writes failed in the recent
+    # sampling window (a single ancient failure must not wedge readiness
+    # forever, so the service tracks a windowed delta, not the total)
+    recent = svc.store_errors_recent
+    total = int(getattr(broker.store, "error_count", 0))
+    checks["store"] = {"ok": recent == 0, "recent_errors": recent,
+                       "total_errors": total}
+    if recent:
+        reasons.append(f"store: {recent} background write failure(s) "
+                       f"in the last {svc.store_error_window} ticks")
+
+    cluster = broker.cluster
+    repl_lag = 0
+    if cluster is not None and cluster.replication is not None:
+        repl_lag = int(cluster.replication.total_lag())
+    repl_ok = repl_lag <= svc.repl_lag_ready
+    checks["replication"] = {
+        "ok": repl_ok, "lag_events": repl_lag,
+        "threshold_events": svc.repl_lag_ready}
+    if not repl_ok:
+        reasons.append(
+            f"replication lag {repl_lag} events > {svc.repl_lag_ready}")
+
+    if cluster is not None and cluster.membership is not None:
+        alive = cluster.membership.alive_members()
+        total_n = len(cluster.membership.members)
+        # strict majority; a single-node "cluster" is always quorate
+        quorate = total_n <= 1 or 2 * len(alive) > total_n
+        checks["quorum"] = {
+            "ok": quorate, "alive": len(alive), "members": total_n}
+        if not quorate:
+            reasons.append(
+                f"cluster quorum lost ({len(alive)}/{total_n} alive)")
+
+    return {
+        "node": broker.trace_node,
+        "live": True,
+        "ready": not reasons,
+        "reasons": reasons,
+        "checks": checks,
+    }

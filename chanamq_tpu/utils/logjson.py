@@ -32,6 +32,12 @@ class JsonLogFormatter(logging.Formatter):
             "msg": record.getMessage(),
             "node": getattr(self._broker, "trace_node", None) or "local",
         }
+        # node health verdict on every line: the telemetry service caches
+        # a one-word state each sampler tick, so this is an attribute
+        # read, never a health evaluation per log record
+        svc = getattr(self._broker, "telemetry", None)
+        if svc is not None:
+            out["health"] = svc.health_state
         from .. import trace
 
         tid = trace.current_trace_id()

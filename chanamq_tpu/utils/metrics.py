@@ -121,6 +121,15 @@ class Metrics:
         self.trace_ctx_recv = 0
         self.trace_evicted = 0
         self.trace_stage_us: "dict[str, Histogram]" = {}
+        # per-entity telemetry (chanamq_tpu/telemetry/): sampler progress,
+        # ring-slot pressure, and alert-engine transitions. All zero unless
+        # the telemetry service is running (chana.mq.telemetry.enabled).
+        self.telemetry_ticks = 0
+        self.telemetry_saturated_ticks = 0
+        self.telemetry_evicted_entities = 0
+        self.telemetry_dropped_entities = 0
+        self.alerts_fired = 0
+        self.alerts_resolved = 0
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -203,6 +212,12 @@ class Metrics:
             "trace_ctx_sent": self.trace_ctx_sent,
             "trace_ctx_recv": self.trace_ctx_recv,
             "trace_evicted": self.trace_evicted,
+            "telemetry_ticks": self.telemetry_ticks,
+            "telemetry_saturated_ticks": self.telemetry_saturated_ticks,
+            "telemetry_evicted_entities": self.telemetry_evicted_entities,
+            "telemetry_dropped_entities": self.telemetry_dropped_entities,
+            "alerts_fired": self.alerts_fired,
+            "alerts_resolved": self.alerts_resolved,
         }
         for key, hist in self.trace_stage_us.items():
             base = key[:-3] if key.endswith("_us") else key

@@ -28,9 +28,25 @@ BENCH_TRACE=1 BENCH_SECONDS=5 timeout -k 10 120 python bench.py --cluster || {
 }
 
 echo "tier1: seeded chaos soak smoke (~5 s: partition + owner crash + slow store)"
-CHAOS_MESSAGES=80 timeout -k 10 180 python bench.py --chaos --seed 42 || {
+# health-gated: the soak itself fails (violation -> exit 1) unless both
+# nodes report ready before load AND the scripted alert phase fires
+# exactly backlog-growth + consumer-stall; the grep double-checks the
+# firing set landed in the report rather than the phase being skipped
+CHAOS_MESSAGES=80 timeout -k 10 180 python bench.py --chaos --seed 42 \
+        | tee /tmp/_t1_chaos.json || {
     rc=$?
     echo "tier1: chaos soak smoke FAILED (rc=$rc) — invariant violation or harness error" >&2
+    exit "$rc"
+}
+grep -q '"fired_rules": \["backlog-growth", "consumer-stall"\]' /tmp/_t1_chaos.json || {
+    echo "tier1: chaos soak report missing the exact alert firings" >&2
+    exit 1
+}
+
+echo "tier1: telemetry overhead smoke (5 s x2: per-entity sampling <= 2%)"
+BENCH_SECONDS=5 timeout -k 10 120 python bench.py --telemetry-overhead || {
+    rc=$?
+    echo "tier1: telemetry overhead smoke FAILED (rc=$rc) — sampling cost over budget" >&2
     exit "$rc"
 }
 

@@ -319,7 +319,7 @@ async def test_admin_chaos_endpoints():
              "delay_ms": 1}]}).encode()
         status, payload = await _admin_request(
             admin.bound_port, "POST", "/admin/chaos/install", body)
-        assert status.startswith("HTTP/1.1 500")
+        assert status.startswith("HTTP/1.1 409")
         assert "chaos disabled" in payload["error"]
 
         srv.broker.chaos_enabled = True
@@ -540,6 +540,12 @@ async def test_seeded_soak_holds_all_invariants():
     assert report["delivered_unique"] == 80
     assert report["post_settle_duplicates"] == 0
     assert report["stream"]["contiguous"] is True
+    # health gate: both nodes reported ready before load was offered
+    assert all(report["health_gate"].values())
+    assert len(report["health_gate"]) == 2
+    # the scripted alert phase fired exactly the expected rules
+    from chanamq_tpu.chaos.soak import EXPECTED_ALERT_RULES
+    assert tuple(report["alerts"]["fired_rules"]) == EXPECTED_ALERT_RULES
     # reproducibility: the installed plan's schedule is seed-determined
     from chanamq_tpu.chaos.soak import default_plan
     assert (default_plan(42, "any:1", 80).fingerprint()

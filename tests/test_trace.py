@@ -298,7 +298,8 @@ async def test_admin_trace_endpoints():
 
         status, body = await _http(
             admin.bound_port, "GET", "/admin/traces/nope%23404")
-        assert status == 500
+        assert status == 404
+        assert "no trace" in body["error"]
 
         status, body = await _http(
             admin.bound_port, "POST", "/admin/traces", b"{}")
