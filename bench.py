@@ -223,6 +223,36 @@ def _tail(path: str, limit: int = 2000) -> str:
         return ""
 
 
+def _reap_children(children: list, consumers: int,
+                   timeout: float) -> "tuple[list[dict], list[str]]":
+    """Collect each child's one-line JSON result (consumers first, then
+    producers, matching spawn order); kills and reports stragglers."""
+    outputs: list[dict] = []
+    errors: list[str] = []
+    for i, child in enumerate(children):
+        role = "consumer" if i < consumers else "producer"
+        try:
+            out, err = child.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            _, err = child.communicate()
+            err_lines = err.decode("utf-8", "replace").strip().splitlines()
+            tail = f": {err_lines[-1][:300]}" if err_lines else ""
+            errors.append(f"{role}[{i}] timed out{tail}")
+            continue  # post-kill partial stdout is not a valid result
+        lines = out.decode().strip().splitlines()
+        if child.returncode != 0 or not lines:
+            err_lines = err.decode("utf-8", "replace").strip().splitlines()
+            tail = err_lines[-1][:300] if err_lines else "no output"
+            errors.append(f"{role}[{i}] rc={child.returncode}: {tail}")
+            continue
+        try:
+            outputs.append(json.loads(lines[-1]))
+        except ValueError:
+            errors.append(f"{role}[{i}] bad output: {lines[-1][:200]}")
+    return outputs, errors
+
+
 def run_spec(name: str, rate: int = 0,
              extra_env: "dict | None" = None) -> dict:
     persistent = False
@@ -292,27 +322,9 @@ def run_spec(name: str, rate: int = 0,
                  "--seconds", str(BENCH_SECONDS), "--rate", str(rate)]
                 + producer_args,
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
-        for i, child in enumerate(children):
-            role = "consumer" if i < consumers else "producer"
-            try:
-                out, err = child.communicate(timeout=BENCH_SECONDS + 60)
-            except subprocess.TimeoutExpired:
-                child.kill()
-                _, err = child.communicate()
-                err_lines = err.decode("utf-8", "replace").strip().splitlines()
-                tail = f": {err_lines[-1][:300]}" if err_lines else ""
-                errors.append(f"{role}[{i}] timed out{tail}")
-                continue  # post-kill partial stdout is not a valid result
-            lines = out.decode().strip().splitlines()
-            if child.returncode != 0 or not lines:
-                err_lines = err.decode("utf-8", "replace").strip().splitlines()
-                tail = err_lines[-1][:300] if err_lines else "no output"
-                errors.append(f"{role}[{i}] rc={child.returncode}: {tail}")
-                continue
-            try:
-                outputs.append(json.loads(lines[-1]))
-            except ValueError:
-                errors.append(f"{role}[{i}] bad output: {lines[-1][:200]}")
+        outs, errs = _reap_children(children, consumers, BENCH_SECONDS + 60)
+        outputs.extend(outs)
+        errors.extend(errs)
         elapsed = time.perf_counter() - t0
     except Exception as exc:  # noqa: BLE001 — a red spec must stay parseable
         for child in children:
@@ -579,6 +591,262 @@ def run_cluster_spec() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+# ---------------------------------------------------------------------------
+# sharded node (chanamq_tpu/shard/): one broker process per core
+# ---------------------------------------------------------------------------
+
+SHARD_QUEUE_COUNT = 4
+SHARD_PRODUCERS = 3
+
+
+def _free_port_block(n: int) -> int:
+    """Base of `n` consecutive free TCP ports (shard i's listener is
+    base + i, so the whole block must be bindable)."""
+    for _ in range(64):
+        socks: list = []
+        try:
+            first = socket.socket()
+            first.bind(("127.0.0.1", 0))
+            base = first.getsockname()[1]
+            socks.append(first)
+            for i in range(1, n):
+                s = socket.socket()
+                socks.append(s)
+                s.bind(("127.0.0.1", base + i))
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError(f"no block of {n} consecutive free ports")
+
+
+async def _shard_wait_ready(admin_ports: "list[int]", count: int,
+                            timeout: float = 30) -> None:
+    """Every worker's admin is up and its membership sees all siblings."""
+    deadline = time.time() + timeout
+    last = "no shard responded yet"
+    while time.time() < deadline:
+        try:
+            if count == 1:
+                await _admin_get(admin_ports[0], "/admin/overview")
+                return
+            converged = 0
+            for port in admin_ports:
+                body = await _admin_get(port, "/admin/cluster")
+                if body.get("enabled") and len(body.get("alive", [])) >= count:
+                    converged += 1
+            if converged == count:
+                return
+            last = f"{converged}/{count} shards converged"
+        except (OSError, ValueError, asyncio.TimeoutError) as exc:
+            last = repr(exc)
+        await asyncio.sleep(0.2)
+    raise RuntimeError(f"sharded node not ready: {last}")
+
+
+async def _shard_wait_metas(admin_ports: "list[int]", n_queues: int,
+                            timeout: float = 15) -> None:
+    """The fire-and-forget metadata broadcast reached every shard — a
+    producer whose connection lands on a shard that hasn't heard of
+    bench_ex yet would publish unroutably."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            bodies = [await _admin_get(p, "/admin/cluster")
+                      for p in admin_ports]
+            if all(b.get("known_queues", 0) >= n_queues for b in bodies):
+                return
+        except (OSError, ValueError, asyncio.TimeoutError):
+            pass
+        await asyncio.sleep(0.1)
+    raise RuntimeError(f"queue metadata did not reach all "
+                       f"{len(admin_ports)} shards")
+
+
+async def _shard_scrape(admin_ports: "list[int]") -> dict:
+    """Per-shard broker counters off each worker's /admin/metrics."""
+    per_shard = {}
+    for i, port in enumerate(admin_ports):
+        snap = await _admin_get(port, "/admin/metrics")
+        per_shard[str(i)] = {
+            "published": snap.get("published_msgs"),
+            "delivered": snap.get("delivered_msgs"),
+            "delivered_per_s": round(
+                (snap.get("delivered_msgs") or 0) / BENCH_SECONDS, 1),
+            "cross_pushes": snap.get("shard_cross_pushes"),
+            "handoffs": snap.get("shard_handoffs"),
+            "restarts": snap.get("shard_restarts"),
+        }
+    return per_shard
+
+
+def run_shard_spec(count: int) -> dict:
+    """One broker *node* at `count` shards (1 = the unsharded baseline):
+    the saturated transient/autoack workload spread over SHARD_QUEUE_COUNT
+    queues, then a paced 1p1c latency phase on its own idle queue. The
+    node is a single subprocess — past one shard it becomes the
+    supervisor and spawns one worker per shard; SO_REUSEPORT spreads the
+    client connections, the hash ring spreads queue ownership, and every
+    cross-shard message rides the UDS data plane. Per-shard counters come
+    from each worker's own admin endpoint (admin base + shard index)."""
+    port = free_port()
+    admin_base = _free_port_block(count)
+    cluster_base = _free_port_block(count)
+    shard_dir = tempfile.mkdtemp(prefix="bench-shards-")
+    env = {**os.environ,
+           "PYTHONPATH": os.path.dirname(os.path.abspath(__file__)),
+           "CHANAMQ_SHARD_COUNT": str(count),
+           "CHANAMQ_SHARD_DIR": shard_dir,
+           "CHANAMQ_CLUSTER_HOST": "127.0.0.1",
+           "CHANAMQ_CLUSTER_PORT": str(cluster_base)}
+    broker_log = tempfile.NamedTemporaryFile(
+        suffix=".log", prefix="bench-shards-", delete=False)
+    broker = subprocess.Popen(
+        [sys.executable, "-m", "chanamq_tpu.broker.server",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--admin-port", str(admin_base), "--log-level", "WARNING"],
+        env=env, stdout=broker_log, stderr=broker_log)
+    admin_ports = [admin_base + i for i in range(count)]
+    keys = [f"bench{i}" for i in range(SHARD_QUEUE_COUNT)]
+    queues = [(f"bench_q{i}", [keys[i]]) for i in range(SHARD_QUEUE_COUNT)]
+    queues.append(("bench_paced", ["paced"]))
+    children: list = []
+    errors: list[str] = []
+    outputs: list[dict] = []
+    paced_outputs: list[dict] = []
+    per_shard: dict = {}
+    paced_rate = 0
+    elapsed = 0.0
+    try:
+        wait_port(port)
+        asyncio.run(_shard_wait_ready(admin_ports, count))
+        # declares idempotently retry: right after boot a shard's outbound
+        # RPC client to a sibling can still be in reconnect backoff from
+        # dialing before that sibling's listener was up, which fails the
+        # forwarded remote declare once
+        for attempt in range(5):
+            try:
+                asyncio.run(setup_topology(port, False, "direct", queues))
+                break
+            except Exception as exc:  # noqa: BLE001
+                if attempt == 4:
+                    raise RuntimeError(
+                        f"topology setup kept failing: {exc!r}") from exc
+                time.sleep(0.5)
+        if count > 1:
+            asyncio.run(_shard_wait_metas(admin_ports, len(queues)))
+        # phase 1: saturated transient/autoack across all queues
+        for i in range(SHARD_QUEUE_COUNT):
+            children.append(subprocess.Popen(
+                [sys.executable, __file__, "--role", "consumer",
+                 "--port", str(port), "--auto-ack", "1",
+                 "--seconds", str(BENCH_SECONDS),
+                 "--queue", f"bench_q{i}"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        time.sleep(0.3)
+        t0 = time.perf_counter()
+        for _ in range(SHARD_PRODUCERS):
+            children.append(subprocess.Popen(
+                [sys.executable, __file__, "--role", "producer",
+                 "--port", str(port), "--persistent", "0",
+                 "--seconds", str(BENCH_SECONDS), "--rate", "0",
+                 "--keys", ",".join(keys)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        outs, errs = _reap_children(
+            children, SHARD_QUEUE_COUNT, BENCH_SECONDS + 60)
+        outputs.extend(outs)
+        errors.extend(errs)
+        elapsed = time.perf_counter() - t0
+        per_shard = asyncio.run(_shard_scrape(admin_ports))
+        # phase 2: paced latency on the idle bench_paced queue (its own
+        # queue so stale saturated-phase backlog can't pollute the p99),
+        # at ~25% of the measured rate — queue delay excluded by design
+        delivered_per_s = sum(
+            o.get("delivered", 0) for o in outputs) / BENCH_SECONDS
+        rate_env = os.environ.get("BENCH_SHARD_PACED_RATE")
+        if rate_env is not None:
+            paced_rate = int(rate_env)
+        else:
+            paced_rate = max(500, int(delivered_per_s * 0.25))
+        if not errors and delivered_per_s > 0:
+            paced_children = [subprocess.Popen(
+                [sys.executable, __file__, "--role", "consumer",
+                 "--port", str(port), "--auto-ack", "1",
+                 "--seconds", str(BENCH_SECONDS),
+                 "--queue", "bench_paced"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)]
+            time.sleep(0.3)
+            paced_children.append(subprocess.Popen(
+                [sys.executable, __file__, "--role", "producer",
+                 "--port", str(port), "--persistent", "0",
+                 "--seconds", str(BENCH_SECONDS),
+                 "--rate", str(paced_rate), "--keys", "paced"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+            paced_outputs, errs = _reap_children(
+                paced_children, 1, BENCH_SECONDS + 60)
+            errors.extend(errs)
+    except Exception as exc:  # noqa: BLE001 — a red spec must stay parseable
+        for child in children:
+            if child.poll() is None:
+                child.kill()
+            child.communicate()  # reap: no zombies/leaked pipe fds
+        errors.append(f"{type(exc).__name__}: {exc}")
+    finally:
+        broker.terminate()
+        try:
+            # past one shard the node is a supervisor: give it time to
+            # SIGTERM and reap every worker before escalating
+            broker.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            broker.kill()
+            broker.wait()
+        broker_log.close()
+        shutil.rmtree(shard_dir, ignore_errors=True)
+    if broker.returncode not in (0, -15):
+        errors.append(f"broker rc={broker.returncode}")
+    if errors:
+        result = {"shards": count, "error": "; ".join(errors)}
+        tail = _tail(broker_log.name)
+        if tail:
+            result["broker_stderr_tail"] = tail[-800:]
+        if outputs:
+            result["partial_outputs"] = outputs
+        try:
+            os.unlink(broker_log.name)
+        except OSError:
+            pass
+        return result
+    try:
+        os.unlink(broker_log.name)
+    except OSError:
+        pass
+    published = sum(o.get("published", 0) for o in outputs)
+    delivered = sum(o.get("delivered", 0) for o in outputs)
+    p99s = [o["p99_us"] for o in outputs if o.get("p99_us") is not None]
+    shard_published = sum(
+        s.get("published") or 0 for s in per_shard.values())
+    cross_pushes = sum(s.get("cross_pushes") or 0 for s in per_shard.values())
+    paced = paced_outputs[0] if paced_outputs else {}
+    return {
+        "shards": count,
+        "published_per_s": round(published / BENCH_SECONDS, 1),
+        "delivered_per_s": round(delivered / BENCH_SECONDS, 1),
+        "published": published,
+        "delivered": delivered,
+        "p99_us": round(max(p99s), 1) if p99s else None,
+        "per_shard": per_shard,
+        "cross_shard_push_ratio": (
+            round(cross_pushes / shard_published, 3)
+            if count > 1 and shard_published else 0.0),
+        "paced_rate": paced_rate,
+        "paced_p50_us": paced.get("p50_us"),
+        "paced_p99_us": paced.get("p99_us"),
+        "wall_s": round(elapsed, 2),
+    }
+
+
 async def _replicate_spec() -> dict:
     """Two in-process nodes with PRIVATE MemoryStores, replicate.factor=2 +
     sync=true: persistent confirmed publishes to the owner, so every confirm
@@ -817,6 +1085,50 @@ def main() -> None:
             **({"error": {"stream_1p3c": result["error"]}}
                if "error" in result else {}),
         }))
+        return
+
+    if "--shard" in sys.argv:
+        # sharded-node scenario: the saturated transient/autoack workload
+        # against a multi-process node at 1/2/4(/N) shards — per-shard and
+        # aggregate throughput, the cross-shard UDS push ratio, and a
+        # paced p99 at the target count; speedup is always vs the 1-shard
+        # run of the same workload
+        idx = sys.argv.index("--shard")
+        try:
+            target = int(sys.argv[idx + 1])
+        except (IndexError, ValueError):
+            target = 2
+        target = max(1, target)
+        counts = sorted({1, target} | {c for c in (2, 4) if c < target})
+        runs: dict = {}
+        for c in counts:
+            runs[str(c)] = run_shard_spec(c)
+            print(f"# shard_{c}: {runs[str(c)]}", file=sys.stderr)
+        base = runs["1"].get("delivered_per_s") or 0
+        speedups = {}
+        for c in counts[1:]:
+            cur = runs[str(c)].get("delivered_per_s")
+            speedups[str(c)] = (round(cur / base, 2)
+                                if base and cur is not None else None)
+        errors = {k: v["error"] for k, v in runs.items() if "error" in v}
+        head = runs[str(target)]
+        print(json.dumps({
+            "metric": f"shard_delivered_msgs_per_s_{target}shards",
+            "value": head.get("delivered_per_s"),
+            "unit": "msgs/s",
+            "vs_baseline": None,
+            "speedup_vs_1shard": speedups,
+            "cross_shard_push_ratio": head.get("cross_shard_push_ratio"),
+            "paced_p99_us": head.get("paced_p99_us"),
+            "per_shard": head.get("per_shard"),
+            "cores": os.cpu_count(),
+            "body_bytes": BODY_BYTES,
+            "seconds": BENCH_SECONDS,
+            "shard_runs": runs,
+            **({"error": errors} if errors else {}),
+        }))
+        if errors:
+            sys.exit(1)  # the tier-1 smoke must fail loudly
         return
 
     if "--chaos" in sys.argv:

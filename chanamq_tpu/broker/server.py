@@ -41,6 +41,7 @@ class BrokerServer:
         max_message_size: int = 128 * 1024 * 1024,
         users: "Optional[dict[str, str]]" = None,
         permissions: "Optional[dict[str, list[str]]]" = None,
+        reuse_port: bool = False,
     ) -> None:
         self.broker = broker or Broker(store=store)
         self.host = host
@@ -65,6 +66,12 @@ class BrokerServer:
         self.permissions = permissions or None
         self.max_message_size = max_message_size
         self.refused_connections = 0
+        # sharded node (chanamq_tpu/shard/): sibling workers share one
+        # AMQP port via SO_REUSEPORT; where that's unavailable the
+        # supervisor accepts and ships fds to handoff_path instead
+        self.reuse_port = reuse_port
+        self.handoff_path: Optional[str] = None
+        self._handoff = None
         self._servers: list[asyncio.AbstractServer] = []
         self._connections: set[AMQPConnection] = set()
 
@@ -78,10 +85,24 @@ class BrokerServer:
             await self.start_listeners()
 
     async def start_listeners(self) -> None:
+        if self.handoff_path is not None:
+            # reuse-port fallback: no TCP listener here — the shard
+            # supervisor accepts and hands client sockets over Unix
+            from ..shard.handoff import HandoffReceiver
+
+            self._handoff = HandoffReceiver(self, self.handoff_path)
+            await self._handoff.start()
+            log.info("AMQP via fd handoff at %s", self.handoff_path)
+            return
+        kwargs: dict = {}
+        if self.reuse_port:
+            kwargs["reuse_port"] = True
         server = await asyncio.start_server(
-            self._on_client, self.host, self.port, backlog=self.backlog)
+            self._on_client, self.host, self.port, backlog=self.backlog,
+            **kwargs)
         self._servers.append(server)
-        log.info("AMQP listening on %s:%d", self.host, self.port)
+        log.info("AMQP listening on %s:%d%s", self.host, self.port,
+                 " (reuse-port)" if self.reuse_port else "")
         if self.tls_port is not None and self.ssl_context is not None:
             tls_server = await asyncio.start_server(
                 self._on_client, self.host, self.tls_port,
@@ -128,6 +149,9 @@ class BrokerServer:
             self._connections.discard(connection)
 
     async def stop(self) -> None:
+        if self._handoff is not None:
+            await self._handoff.stop()
+            self._handoff = None
         for server in self._servers:
             server.close()
         # kick live connections first: in py3.12 Server.wait_closed() waits
@@ -284,7 +308,39 @@ async def run_node(config) -> None:
 
     from ..rest.admin import AdminServer
 
+    # multi-process sharding: with chana.mq.shard.count past 1 this
+    # process becomes the supervisor (spawns one worker per shard and
+    # returns when they're all down); workers carry CHANAMQ_SHARD_INDEX
+    # and fall through to the normal boot below with shard wiring
+    shard_index_env = os.environ.get("CHANAMQ_SHARD_INDEX")
+    if shard_index_env is None:
+        from ..shard import resolve_count
+
+        if resolve_count(config) > 1:
+            from ..shard.supervisor import run_supervisor
+
+            await run_supervisor(config)
+            return
+
     server = BrokerServer.from_config(config)
+    shard_topo = None
+    shard_index = 0
+    if shard_index_env is not None:
+        from ..shard import ShardTopology
+
+        shard_index = int(shard_index_env)
+        shard_topo = ShardTopology.from_env(config, shard_index)
+        server.broker.shard_info = {
+            "index": shard_index,
+            "count": shard_topo.count,
+            "name": shard_topo.name(shard_index),
+        }
+        server.broker.metrics.shard_restarts = int(
+            os.environ.get("CHANAMQ_SHARD_RESTARTS", "0") or 0)
+        if config.bool("chana.mq.shard.reuse-port"):
+            server.reuse_port = True
+        else:
+            server.handoff_path = shard_topo.handoff_path(shard_index)
     if config.bool("chana.mq.log.json"):
         # swap formatters before any traffic so every line is one JSON
         # object stamped with node id + active trace id
@@ -359,6 +415,10 @@ async def run_node(config) -> None:
                 consume_credit=config.int("chana.mq.cluster.consume-credit"),
                 call_timeout_s=config.duration_s(
                     "chana.mq.cluster.call-timeout") or 10.0,
+                uds_path=(shard_topo.uds_path(shard_index)
+                          if shard_topo is not None else None),
+                uds_map=(shard_topo.uds_map_for(shard_index)
+                         if shard_topo is not None else None),
             )
             await cluster.start()
         if stop_event.is_set():

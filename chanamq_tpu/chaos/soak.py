@@ -81,9 +81,18 @@ def default_plan(seed: int, owner: str, messages: int) -> FaultPlan:
 async def run_soak(
     seed: int, *, messages: int = 160, stream_records: int = 40,
     plan: Optional[FaultPlan] = None, metrics_sink=None,
+    uds: bool = False,
 ) -> dict:
     """Run the workload under the plan; returns a report whose
-    ``violations`` list is empty iff every invariant held."""
+    ``violations`` list is empty iff every invariant held.
+
+    ``uds=True`` runs the interconnect over Unix-domain sockets — the
+    exact transport sibling shards use (shard/) — so the crash becomes
+    the shard-crash drill: same plan, same invariants, plus
+    exactly-one ownership re-hash observed by the survivor."""
+    import os
+    import tempfile
+
     from ..amqp.properties import BasicProperties
     from ..client.client import AMQPClient
     from ..store.memory import MemoryStore
@@ -92,14 +101,17 @@ async def run_soak(
     from ..telemetry import TelemetryService
     from ..telemetry.alerts import default_rules as alert_defaults
 
-    async def start_node(seeds):
+    uds_dir = tempfile.mkdtemp(prefix="chanamq-soak-") if uds else None
+
+    async def start_node(seeds, uds_path=None):
         srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
                            store=MemoryStore())
         await srv.start()
         cl = ClusterNode(srv.broker, "127.0.0.1", 0, seeds,
                          heartbeat_interval_s=0.2, failure_timeout_s=1.5,
                          replicate_factor=2, replicate_sync=True,
-                         replicate_ack_timeout_ms=2000)
+                         replicate_ack_timeout_ms=2000,
+                         uds_path=uds_path)
         await cl.start()
         # tick-driven telemetry: the harness calls sample_tick at scripted
         # points instead of starting the timer task, so the alert engine's
@@ -117,8 +129,16 @@ async def run_soak(
     conns: list = []
     violations: list[str] = []
     try:
-        a_srv, a_cl = await start_node([])
-        b_srv, b_cl = await start_node([a_cl.name])
+        a_path = os.path.join(uds_dir, "a.sock") if uds_dir else None
+        b_path = os.path.join(uds_dir, "b.sock") if uds_dir else None
+        a_srv, a_cl = await start_node([], uds_path=a_path)
+        b_srv, b_cl = await start_node([a_cl.name], uds_path=b_path)
+        if uds:
+            # ephemeral cluster ports: names exist only after start, so
+            # the sibling map is patched in afterwards (real shards use
+            # fixed base+index ports and get the map at construction)
+            a_cl.uds_map[b_cl.name] = b_path
+            b_cl.uds_map[a_cl.name] = a_path
         for _ in range(100):
             if (len(a_cl.membership.alive_members()) == 2
                     and len(b_cl.membership.alive_members()) == 2):
@@ -295,6 +315,12 @@ async def run_soak(
         # -- promotion accounting (A's metrics survive its stop)
         promotions = (a_srv.broker.metrics.repl_promotions
                       + b_srv.broker.metrics.repl_promotions)
+        # ownership re-hash accounting: each DOWN event a node observes
+        # re-hashes the ring once and bumps shard_handoffs; with 2 nodes
+        # only the survivor can observe the crash, so a crash run must
+        # show exactly one re-hash cluster-wide and a clean run none
+        handoffs = (a_srv.broker.metrics.shard_handoffs
+                    + b_srv.broker.metrics.shard_handoffs)
         expect_crash = any(r.kind == "crash" for r in plan.rules)
         if expect_crash:
             if not crashed.is_set():
@@ -302,8 +328,15 @@ async def run_soak(
             if promotions != 1:
                 violations.append(
                     f"expected exactly 1 promotion, saw {promotions}")
-        elif promotions:
-            violations.append(f"unexpected promotion(s): {promotions}")
+            if handoffs != 1:
+                violations.append(
+                    f"expected exactly 1 ownership re-hash, saw {handoffs}")
+        else:
+            if promotions:
+                violations.append(f"unexpected promotion(s): {promotions}")
+            if handoffs:
+                violations.append(
+                    f"unexpected ownership re-hash(es): {handoffs}")
 
         if max_backoff_seen > BACKOFF_BUDGET_S:
             violations.append(
@@ -327,6 +360,8 @@ async def run_soak(
             "duplicates": duplicates,
             "post_settle_duplicates": len(post_settle),
             "promotions": promotions,
+            "handoffs": handoffs,
+            "interconnect": "uds" if uds else "tcp",
             "crashed": crashed.is_set(),
             "max_backoff_s": round(max_backoff_seen, 3),
             "stream": stream,

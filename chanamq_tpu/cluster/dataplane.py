@@ -66,6 +66,7 @@ from .rpc import (
     RpcError,
     RpcTimeout,
     _read_frame,
+    as_transport,
     encode_data_frame,
 )
 
@@ -293,12 +294,15 @@ class DataStream:
     sibling streams (other queues) keep moving."""
 
     def __init__(
-        self, host: str, port: int, *, inflight: int = 32,
+        self, host, port: int = 0, *, inflight: int = 32,
         timeout_s: float = 20.0, connect_timeout_s: float = 3.0,
         metrics=None,
     ) -> None:
-        self.host = host
-        self.port = port
+        # host may be a Transport (UDS shard fast path) or a plain host
+        # string with a port (the historical TCP signature)
+        self.transport = as_transport(host, port)
+        self.host = getattr(self.transport, "host", self.transport.label)
+        self.port = getattr(self.transport, "port", 0)
         self.timeout_s = timeout_s
         self.connect_timeout_s = connect_timeout_s
         self.metrics = metrics
@@ -329,13 +333,12 @@ class DataStream:
             try:
                 if chaos.ACTIVE is not None:
                     fault = await chaos.ACTIVE.fire(
-                        "data.connect", peer=f"{self.host}:{self.port}",
+                        "data.connect", peer=self.transport.peer,
                         on_error=_chaos_data_error)
                     if fault is not None:
                         raise RpcError(fault.code, fault.message)
                 reader, writer = await asyncio.wait_for(
-                    asyncio.open_connection(self.host, self.port),
-                    self.connect_timeout_s)
+                    self.transport.dial(), self.connect_timeout_s)
             except BaseException as exc:
                 self._backoff.failed()
                 self.last_error = repr(exc)
@@ -354,7 +357,7 @@ class DataStream:
                 corr_id, kind, _method, payload = await _read_frame(reader)
                 if chaos.ACTIVE is not None:
                     fault = chaos.ACTIVE.decide(
-                        "data.read", peer=f"{self.host}:{self.port}")
+                        "data.read", peer=self.transport.peer)
                     if fault is not None:
                         if fault.kind == "latency":
                             await asyncio.sleep(fault.delay_s)
@@ -381,12 +384,12 @@ class DataStream:
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError) as exc:
             self.last_error = repr(exc)
         except FrameTooLarge as exc:
-            log.warning("data stream %s:%s desynced: %s; reconnecting",
-                        self.host, self.port, exc)
+            log.warning("data stream %s desynced: %s; reconnecting",
+                        self.transport.label, exc)
             self.last_error = repr(exc)
         finally:
             self._fail_waiters(
-                RpcError("disconnected", f"{self.host}:{self.port}"))
+                RpcError("disconnected", self.transport.label))
             if self._writer is writer:
                 self._writer = None
             try:
@@ -415,7 +418,7 @@ class DataStream:
             writer = await self._ensure_connected()
             if chaos.ACTIVE is not None:
                 fault = await chaos.ACTIVE.fire(
-                    "data.send", peer=f"{self.host}:{self.port}",
+                    "data.send", peer=self.transport.peer,
                     on_error=_chaos_data_error)
                 if fault is not None:
                     if fault.kind == "drop":
@@ -446,7 +449,7 @@ class DataStream:
         writer = await self._ensure_connected()
         if chaos.ACTIVE is not None:
             fault = await chaos.ACTIVE.fire(
-                "data.event", peer=f"{self.host}:{self.port}",
+                "data.event", peer=self.transport.peer,
                 on_error=_chaos_data_error)
             if fault is not None:
                 return  # fire-and-forget: any transport fault = silent loss
@@ -481,7 +484,7 @@ class PeerDataPlane:
     flush; ``drain_settles`` fences them for control-plane ordering."""
 
     def __init__(
-        self, host: str, port: int, *, streams: int = 2,
+        self, host, port: int = 0, *, streams: int = 2,
         inflight_per_stream: int = 32, flush_window_us: int = 200,
         flush_max_bytes: int = 1 << 20, flush_max_count: int = 512,
         timeout_s: float = 20.0, metrics=None, node_tag: str = "",
@@ -490,11 +493,14 @@ class PeerDataPlane:
         # local node name for trace span attribution (cluster-push and
         # flush-wait happen on the submitting side)
         self.node_tag = node_tag
+        self.transport = as_transport(host, port)
+        # intra-node shard hop: peer is a sibling shard over a Unix socket
+        self.intra_node = self.transport.kind == "uds"
         self.flush_window_s = max(0.0, flush_window_us / 1e6)
         self.flush_max_bytes = max(1, flush_max_bytes)
         self.flush_max_count = max(1, flush_max_count)
         self.streams = [
-            DataStream(host, port, inflight=inflight_per_stream,
+            DataStream(self.transport, inflight=inflight_per_stream,
                        timeout_s=timeout_s, metrics=metrics)
             for _ in range(max(1, streams))
         ]
@@ -549,6 +555,8 @@ class PeerDataPlane:
         acc[2] += nbytes
         if self.metrics is not None:
             self.metrics.rpc_push_records += 1
+            if self.intra_node:
+                self.metrics.shard_cross_pushes += 1
         fut = acc[3]
         if acc[1] >= self.flush_max_count or acc[2] >= self.flush_max_bytes:
             if self.metrics is not None:
@@ -584,9 +592,15 @@ class PeerDataPlane:
                 # shares the queue wait (submit->send) and the round trip
                 now = time.perf_counter_ns()
                 node = self.node_tag
+                intra = self.intra_node
                 for _i, tr in traces:
                     tr.span(trace.CLUSTER_PUSH, tr.pending_ns, t_sent, node)
                     tr.span(trace.FLUSH_WAIT, t_sent, now, node)
+                    if intra:
+                        # same wall-clock interval seen as a shard hop:
+                        # lets stitched traces separate intra-node cost
+                        tr.span(trace.INTRA_SHARD_HOP,
+                                tr.pending_ns, now, node)
             if not fut.done():
                 fut.set_result(True)
 
@@ -645,8 +659,8 @@ class PeerDataPlane:
             try:
                 await stream.request(METHOD_SETTLE_MANY, payload)
             except BaseException as exc:
-                log.warning("settle batch to %s:%s failed: %r",
-                            stream.host, stream.port, exc)
+                log.warning("settle batch to %s failed: %r",
+                            stream.transport.label, exc)
                 if not fut.done():
                     # settles are best-effort like the old settle_bg (an
                     # unacked delivery requeues via failure detection), so
@@ -696,8 +710,8 @@ class PeerDataPlane:
             except (RpcError, OSError) as exc:
                 # delivery loss is the design contract (unacked copies
                 # requeue via failure detection; no_ack is at-most-once)
-                log.debug("deliver_many to %s:%s dropped: %r",
-                          stream.host, stream.port, exc)
+                log.debug("deliver_many to %s dropped: %r",
+                          stream.transport.label, exc)
 
         asyncio.get_event_loop().create_task(_send())
 
@@ -732,6 +746,7 @@ class PeerDataPlane:
 
     def stats(self) -> dict:
         return {
+            "transport": self.transport.kind,
             "streams": len(self.streams),
             "inflight": [s.inflight for s in self.streams],
             "backoff": [s.backoff_state() for s in self.streams],

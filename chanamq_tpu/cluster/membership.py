@@ -16,7 +16,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from .rpc import RpcClient, RpcError, RpcServer
+from .rpc import RpcClient, RpcError, RpcServer, UdsTransport
 
 log = logging.getLogger("chanamq.membership")
 
@@ -54,9 +54,13 @@ class Membership:
         *,
         heartbeat_interval_s: float = 1.0,
         failure_timeout_s: float = 5.0,
+        uds_map: Optional[dict[str, str]] = None,
     ) -> None:
         self.self_name = self_name
         self.seeds = [s for s in seeds if s != self_name]
+        # member name -> Unix-socket path for sibling shards on this
+        # machine: heartbeats and control RPC to them skip the TCP stack
+        self.uds_map = dict(uds_map or {})
         self.heartbeat_interval_s = heartbeat_interval_s
         self.failure_timeout_s = failure_timeout_s
         self.incarnation = int(time.time() * 1000)
@@ -88,10 +92,14 @@ class Membership:
     def client(self, name: str) -> RpcClient:
         client = self._clients.get(name)
         if client is None or client.closed:
-            member = self.members.get(name)
-            host, port = (member.host, member.port) if member else (
-                name.rsplit(":", 1)[0], int(name.rsplit(":", 1)[1]))
-            client = RpcClient(host, port)
+            uds_path = self.uds_map.get(name)
+            if uds_path is not None:
+                client = RpcClient(UdsTransport(uds_path, peer=name))
+            else:
+                member = self.members.get(name)
+                host, port = (member.host, member.port) if member else (
+                    name.rsplit(":", 1)[0], int(name.rsplit(":", 1)[1]))
+                client = RpcClient(host, port)
             self._clients[name] = client
         return client
 

@@ -39,7 +39,7 @@ from . import dataplane as dp
 from .dataplane import PeerDataPlane
 from .hashring import HashRing
 from .membership import Member, Membership
-from .rpc import RpcError, RpcServer
+from .rpc import RpcError, RpcServer, UdsTransport
 
 if TYPE_CHECKING:  # pragma: no cover
     from ..broker.broker import Broker
@@ -95,11 +95,16 @@ class ClusterNode:
         flush_max_count: int = 512,
         consume_credit: int = DEFAULT_CONSUME_CREDIT,
         call_timeout_s: float = 10.0,
+        uds_path: Optional[str] = None,
+        uds_map: Optional[dict[str, str]] = None,
     ) -> None:
         self.broker = broker
-        self.rpc = RpcServer(host, port)
+        self.rpc = RpcServer(host, port, uds_path=uds_path)
         self._host = host
         self._seeds = seeds or []
+        # sibling shards on this machine (member name -> Unix-socket
+        # path): control and data planes toward them dial UDS, not TCP
+        self.uds_map = dict(uds_map or {})
         self._hb = heartbeat_interval_s
         self._ft = failure_timeout_s
         self.membership: Optional[Membership] = None
@@ -115,8 +120,10 @@ class ClusterNode:
         # (vhost, queue, tag) -> info
         self._remote_consumers: dict[tuple[str, str, str], dict] = {}
         # data-plane fast path (chana.mq.cluster.streams / flush-window-us /
-        # flush-max-*): binary batched pushes, settles, and deliveries
-        self._dataplanes: dict[str, PeerDataPlane] = {}
+        # flush-max-*): binary batched pushes, settles, and deliveries.
+        # Keyed (peer name, transport kind) so a UDS sibling never shares
+        # striping/backoff state with a same-named TCP peer.
+        self._dataplanes: dict[tuple[str, str], PeerDataPlane] = {}
         self._dp_streams = max(1, streams)
         self._dp_inflight = max(1, stream_inflight)
         self._dp_flush_window_us = flush_window_us
@@ -126,6 +133,13 @@ class ClusterNode:
         # default per-call ask window for control RPCs (individual calls
         # may still override — e.g. the 5 s snapshot pull at boot)
         self.call_timeout_s = call_timeout_s
+        # metadata anti-entropy: broadcasts are fire-and-forget, so a peer
+        # briefly unreachable (reconnect backoff during a sharded node's
+        # boot, a blip mid-partition) can miss a queue.declared for good.
+        # A periodic add-only snapshot merge from one rotating peer heals
+        # those gaps without ever overwriting newer local state.
+        self._anti_entropy_s = max(1.0, failure_timeout_s)
+        self._anti_entropy_task: Optional[asyncio.Task] = None
         self.name: str = ""
         broker.cluster = self
         self._register_handlers()
@@ -152,7 +166,8 @@ class ClusterNode:
             trace.ACTIVE.node = self.name
         self.membership = Membership(
             self.name, self._seeds, self.rpc,
-            heartbeat_interval_s=self._hb, failure_timeout_s=self._ft)
+            heartbeat_interval_s=self._hb, failure_timeout_s=self._ft,
+            uds_map=self.uds_map)
         self.membership.listeners.append(self._on_membership_event)
         await self.membership.start()
         self.ring.set_nodes(self.membership.alive_members())
@@ -181,8 +196,17 @@ class ClusterNode:
             self.broker.idgen = IdGenerator(worker_id & MAX_WORKER_ID)
         except (asyncio.TimeoutError, RpcError, OSError):
             log.warning("%s: worker-id lease failed; keeping local id", self.name)
+        self._anti_entropy_task = asyncio.get_event_loop().create_task(
+            self._anti_entropy_loop())
 
     async def stop(self) -> None:
+        if self._anti_entropy_task is not None:
+            self._anti_entropy_task.cancel()
+            try:
+                await self._anti_entropy_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._anti_entropy_task = None
         dataplanes, self._dataplanes = self._dataplanes, {}
         for plane in dataplanes.values():
             await plane.close()
@@ -361,9 +385,13 @@ class ClusterNode:
         if event == "down":
             # tear down the dead peer's data streams: buffered batches fail
             # fast instead of dialing a corpse until their timeouts
-            plane = self._dataplanes.pop(member.name, None)
-            if plane is not None:
-                asyncio.get_event_loop().create_task(plane.close())
+            for key in [k for k in self._dataplanes if k[0] == member.name]:
+                plane = self._dataplanes.pop(key, None)
+                if plane is not None:
+                    asyncio.get_event_loop().create_task(plane.close())
+            # one ownership re-hash per observed peer death — the soak's
+            # "exactly-one re-hash" invariant counts these
+            self.broker.metrics.shard_handoffs += 1
         if event == "down":
             # a dead node can't serve anything: clear its holderships so
             # queue_owner falls back to the ring (node names embed ephemeral
@@ -460,15 +488,24 @@ class ClusterNode:
             method, payload, timeout_s=timeout_s or self.call_timeout_s)
 
     def dataplane(self, node: str) -> PeerDataPlane:
-        """The binary fast path toward a peer (lazily dialed, N streams)."""
-        plane = self._dataplanes.get(node)
+        """The binary fast path toward a peer (lazily dialed, N streams).
+        Sibling shards (uds_map) get a Unix-socket transport; remote nodes
+        get TCP — the two never share a plane."""
+        uds_path = self.uds_map.get(node)
+        kind = "uds" if uds_path is not None else "tcp"
+        plane = self._dataplanes.get((node, kind))
         if plane is None or plane.closed:
-            member = (self.membership.members.get(node)
-                      if self.membership is not None else None)
-            host, port = (member.host, member.port) if member is not None \
-                else (node.rsplit(":", 1)[0], int(node.rsplit(":", 1)[1]))
+            if uds_path is not None:
+                target: Any = UdsTransport(uds_path, peer=node)
+                port = 0
+            else:
+                member = (self.membership.members.get(node)
+                          if self.membership is not None else None)
+                target, port = (member.host, member.port) \
+                    if member is not None \
+                    else (node.rsplit(":", 1)[0], int(node.rsplit(":", 1)[1]))
             plane = PeerDataPlane(
-                host, port,
+                target, port,
                 streams=self._dp_streams,
                 inflight_per_stream=self._dp_inflight,
                 flush_window_us=self._dp_flush_window_us,
@@ -476,7 +513,7 @@ class ClusterNode:
                 flush_max_count=self._dp_flush_max_count,
                 metrics=self.broker.metrics,
                 node_tag=self.name)
-            self._dataplanes[node] = plane
+            self._dataplanes[(node, kind)] = plane
         return plane
 
     async def _event(self, node: str, method: str, payload: dict) -> None:
@@ -575,6 +612,67 @@ class ClusterNode:
         for key, meta in (snapshot.get("queues") or {}).items():
             vhost, _, name = key.partition("\x00")
             self.queue_metas[(vhost, name)] = dict(meta)
+
+    async def _anti_entropy_loop(self) -> None:
+        """Heal lost meta broadcasts: every failure-timeout, pull one
+        rotating alive peer's snapshot and merge entries this node is
+        missing. Steady state is a no-op (no route-cache invalidation)."""
+        peer_idx = 0
+        while True:
+            await asyncio.sleep(self._anti_entropy_s)
+            if self.membership is None:
+                continue
+            peers = [n for n in self.membership.alive_members()
+                     if n != self.name]
+            if not peers:
+                continue
+            peer = peers[peer_idx % len(peers)]
+            peer_idx += 1
+            try:
+                snapshot = await self.membership.client(peer).call(
+                    "cluster.snapshot", {}, timeout_s=5)
+                await self._merge_snapshot(snapshot, peer)
+            except (RpcError, OSError) as exc:
+                log.debug("anti-entropy pull from %s failed: %r", peer, exc)
+
+    async def _merge_snapshot(self, snapshot: dict, peer: str) -> None:
+        """Add-only snapshot merge: fill in queue metas, exchanges and
+        bindings this node has never heard of. Existing local entries are
+        never overwritten — local state may be newer (fresher holders,
+        post-promotion metas) than the peer's."""
+        merged = 0
+        for key, meta in (snapshot.get("queues") or {}).items():
+            vhost, _, name = key.partition("\x00")
+            if (vhost, name) not in self.queue_metas:
+                self.queue_metas[(vhost, name)] = dict(meta)
+                merged += 1
+        for ex in snapshot.get("exchanges") or []:
+            vhost_name = str(ex.get("vhost", ""))
+            vhost = self.broker.vhosts.get(vhost_name)
+            exchange = (vhost.exchanges.get(str(ex.get("name")))
+                        if vhost is not None else None)
+            missing = exchange is None
+            if not missing:
+                have = {(k, q)
+                        for k, q, _a in exchange.matcher.bindings()}
+                missing = any(
+                    (str(b["key"]), str(b["queue"])) not in have
+                    for b in ex.get("binds") or [])
+            if not missing and ex.get("ex_binds"):
+                have_ex = {(k, d) for k, d, _a in (
+                    exchange.ex_matcher.bindings()
+                    if exchange.ex_matcher is not None else [])}
+                missing = any(
+                    (str(b["key"]), str(b["destination"])) not in have_ex
+                    for b in ex["ex_binds"])
+            if missing:
+                await self._h_meta_apply({"kind": "exchange.declared", **ex})
+                merged += 1
+        if merged:
+            self.broker.invalidate_routes()
+            log.info("%s: anti-entropy merged %d missing meta entr%s "
+                     "from %s", self.name, merged,
+                     "y" if merged == 1 else "ies", peer)
 
     async def _h_meta_apply(self, payload: dict) -> dict:
         """Apply one replicated metadata mutation (broadcast receiver).

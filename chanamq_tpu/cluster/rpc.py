@@ -1,4 +1,4 @@
-"""Host-to-host RPC over TCP.
+"""Host-to-host RPC over TCP or Unix-domain sockets.
 
 The DCN control-plane analogue of the reference's Akka artery remoting
 (chana-mq-base reference.conf:16-23; messaging pattern SURVEY.md §5:
@@ -6,6 +6,13 @@ request/response `ask` with timeout + fire-and-forget `tell`). Wire format
 reuses the framework's own AMQP field-table codec for payloads (tables carry
 nested tables, byte arrays, ints — everything entity ops need), so the
 cluster layer introduces no second serialization scheme and no pickle.
+
+Where a peer lives is abstracted behind a small :class:`Transport` seam:
+``TcpTransport`` for inter-node links, ``UdsTransport`` for the intra-node
+shard fast path (chanamq_tpu/shard/). Both planes share one codec, flush,
+and credit implementation; only the dial differs. Per-peer state keys on
+(peer, transport.kind) so a UDS peer never collides with a TCP peer in
+counters or backoff bookkeeping.
 
 Frame: u32 body-length | u64 correlation-id | u8 kind | shortstr method |
        table payload
@@ -25,6 +32,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import random
 import struct
 from io import BytesIO
@@ -123,15 +131,103 @@ class FrameTooLarge(RpcError):
         super().__init__("frame_too_large", detail)
 
 
-class RpcServer:
-    """Listens for peer connections; dispatches requests to handlers."""
+# ---------------------------------------------------------------------------
+# transports
+# ---------------------------------------------------------------------------
+
+class Transport:
+    """Where a peer lives and how to dial it.
+
+    ``label`` names the endpoint for logs and backoff surfaces; ``peer``
+    is the identity the chaos seams match rules against — for a UDS link
+    to a sibling shard it carries the peer's CLUSTER name, so a fault rule
+    scoped to a node fires regardless of which transport reaches it."""
+
+    __slots__ = ()
+    kind: str = "tcp"
+
+    @property
+    def label(self) -> str:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    @property
+    def peer(self) -> str:
+        return self.label
+
+    async def dial(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class TcpTransport(Transport):
+    __slots__ = ("host", "port")
+    kind = "tcp"
 
     def __init__(self, host: str, port: int) -> None:
         self.host = host
+        self.port = int(port)
+
+    @property
+    def label(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def dial(self):
+        return await asyncio.open_connection(self.host, self.port)
+
+    def __repr__(self) -> str:
+        return f"TcpTransport({self.label})"
+
+
+class UdsTransport(Transport):
+    """Unix-domain socket to a process on this machine (a sibling shard):
+    same frames, same micro-batching, no TCP stack in the path."""
+
+    __slots__ = ("path", "_peer")
+    kind = "uds"
+
+    def __init__(self, path: str, peer: Optional[str] = None) -> None:
+        self.path = path
+        self._peer = peer
+
+    @property
+    def label(self) -> str:
+        return f"uds:{self.path}"
+
+    @property
+    def peer(self) -> str:
+        return self._peer or self.label
+
+    async def dial(self):
+        opener = getattr(asyncio, "open_unix_connection", None)
+        if opener is None:  # non-unix platform
+            raise RpcError("unsupported", "unix sockets unavailable")
+        return await opener(self.path)
+
+    def __repr__(self) -> str:
+        return f"UdsTransport({self.path})"
+
+
+def as_transport(host, port: int = 0) -> Transport:
+    """Back-compat shim: callers hand either a Transport or (host, port)."""
+    return host if isinstance(host, Transport) else TcpTransport(host, port)
+
+
+class RpcServer:
+    """Listens for peer connections; dispatches requests to handlers.
+
+    Besides the TCP endpoint an optional Unix-domain listener (``uds_path``)
+    serves the same handlers over the same frames — the intra-node shard
+    fast path dials it instead of looping through TCP."""
+
+    def __init__(
+        self, host: str, port: int, *, uds_path: Optional[str] = None,
+    ) -> None:
+        self.host = host
         self.port = port
+        self.uds_path = uds_path
         self.handlers: dict[str, Handler] = {}
         self.binary_handlers: dict[int, BinaryHandler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
+        self._uds_server: Optional[asyncio.AbstractServer] = None
         self._peer_writers: set[asyncio.StreamWriter] = set()
 
     def register(self, method: str, handler: Handler) -> None:
@@ -144,6 +240,21 @@ class RpcServer:
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._on_client, self.host, self.port)
+        if self.uds_path:
+            starter = getattr(asyncio, "start_unix_server", None)
+            if starter is None:  # non-unix platform: TCP only
+                log.warning("unix sockets unavailable; skipping %s",
+                            self.uds_path)
+                self.uds_path = None
+            else:
+                # a stale socket file from a crashed predecessor blocks the
+                # bind; the supervisor guarantees single ownership per path
+                try:
+                    os.unlink(self.uds_path)
+                except FileNotFoundError:
+                    pass
+                self._uds_server = await starter(
+                    self._on_client, path=self.uds_path)
 
     @property
     def bound_port(self) -> int:
@@ -151,8 +262,11 @@ class RpcServer:
         return self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
+        servers = [s for s in (self._server, self._uds_server) if s is not None]
+        self._server = self._uds_server = None
+        if servers:
+            for server in servers:
+                server.close()
             # close accepted connections first: py3.12 wait_closed() blocks
             # until every connection handler finishes
             for writer in list(self._peer_writers):
@@ -160,8 +274,13 @@ class RpcServer:
                     writer.close()
                 except Exception:
                     pass
-            await self._server.wait_closed()
-            self._server = None
+            for server in servers:
+                await server.wait_closed()
+        if self.uds_path:
+            try:
+                os.unlink(self.uds_path)
+            except OSError:
+                pass
 
     async def _on_client(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -322,11 +441,14 @@ class RpcClient:
     stalling each for the full ask window)."""
 
     def __init__(
-        self, host: str, port: int, *, timeout_s: float = 20.0,
+        self, host, port: int = 0, *, timeout_s: float = 20.0,
         connect_timeout_s: float = 3.0,
     ) -> None:
-        self.host = host
-        self.port = port
+        # host may be a Transport (UDS shard fast path) or a plain host
+        # string with a port (the historical TCP signature)
+        self.transport = as_transport(host, port)
+        self.host = getattr(self.transport, "host", self.transport.label)
+        self.port = getattr(self.transport, "port", 0)
         # default ask window (the reference's 20 s internal ask timeout);
         # every call() may override it per request
         self.timeout_s = timeout_s
@@ -359,13 +481,12 @@ class RpcClient:
             try:
                 if chaos.ACTIVE is not None:
                     fault = await chaos.ACTIVE.fire(
-                        "rpc.connect", peer=f"{self.host}:{self.port}",
+                        "rpc.connect", peer=self.transport.peer,
                         on_error=_chaos_rpc_error)
                     if fault is not None:
                         raise RpcError(fault.code, fault.message)
                 reader, writer = await asyncio.wait_for(
-                    asyncio.open_connection(self.host, self.port),
-                    self.connect_timeout_s)
+                    self.transport.dial(), self.connect_timeout_s)
             except BaseException as exc:
                 self._backoff.failed()
                 self.last_error = repr(exc)
@@ -385,7 +506,7 @@ class RpcClient:
                 corr_id, kind, _method, payload = await _read_frame(reader)
                 if chaos.ACTIVE is not None:
                     fault = chaos.ACTIVE.decide(
-                        "rpc.read", peer=f"{self.host}:{self.port}")
+                        "rpc.read", peer=self.transport.peer)
                     if fault is not None:
                         if fault.kind == "latency":
                             await asyncio.sleep(fault.delay_s)
@@ -411,11 +532,12 @@ class RpcClient:
             # mid-stream desync: close the transport (finally below) so the
             # next call reconnects cleanly; in-flight waiters fail with a
             # reconnectable error rather than the loop dying unobserved
-            log.warning("rpc client %s:%s desynced: %s; reconnecting",
-                        self.host, self.port, exc)
+            log.warning("rpc client %s desynced: %s; reconnecting",
+                        self.transport.label, exc)
             self.last_error = repr(exc)
         finally:
-            self._fail_waiters(RpcError("disconnected", f"{self.host}:{self.port}"))
+            self._fail_waiters(
+                RpcError("disconnected", self.transport.label))
             # close OUR writer (dead peer), not whatever reconnect may have
             # installed since; abandoning it would leak the socket until GC
             if self._writer is writer:
@@ -441,7 +563,7 @@ class RpcClient:
         writer = await self._ensure_connected()
         if chaos.ACTIVE is not None:
             fault = await chaos.ACTIVE.fire(
-                "rpc.call", peer=f"{self.host}:{self.port}",
+                "rpc.call", peer=self.transport.peer,
                 on_error=_chaos_rpc_error)
             if fault is not None:
                 if fault.kind == "drop":
@@ -467,7 +589,7 @@ class RpcClient:
         writer = await self._ensure_connected()
         if chaos.ACTIVE is not None:
             fault = await chaos.ACTIVE.fire(
-                "rpc.event", peer=f"{self.host}:{self.port}",
+                "rpc.event", peer=self.transport.peer,
                 on_error=_chaos_rpc_error)
             if fault is not None:
                 return  # fire-and-forget: any transport fault = silent loss

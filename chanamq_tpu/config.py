@@ -146,6 +146,22 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.cluster.flush-max-count": 512,
     "chana.mq.cluster.consume-credit": 1024,
     "chana.mq.cluster.call-timeout": "10s",
+    # multi-process sharding (chanamq_tpu/shard/): count > 1 makes
+    # `python -m chanamq_tpu.broker.server` run a supervisor that spawns
+    # one worker process per shard; 0 = auto (os.cpu_count()); 1 = off.
+    # Workers share the AMQP port via SO_REUSEPORT (or the fd-handoff
+    # acceptor when reuse-port is unavailable) and talk to each other
+    # over Unix sockets in shard.dir using the binary data plane.
+    "chana.mq.shard.count": 1,
+    "chana.mq.shard.dir": "",              # "" = <store dir or cwd>/shards
+    "chana.mq.shard.reuse-port": True,     # False forces the fd handoff
+    # intra-node membership runs much tighter than WAN defaults: sibling
+    # death must re-hash ownership in well under a second
+    "chana.mq.shard.heartbeat-interval": "200ms",
+    "chana.mq.shard.failure-timeout": "1.5s",
+    # supervisor restart throttle for crashed workers
+    "chana.mq.shard.restart-backoff": "500ms",
+    "chana.mq.shard.max-restarts": 16,     # per shard; then left down
     # queue replication (replicate/): each queue's mutations are log-shipped
     # to factor-1 follower nodes which keep a warm passive copy; on owner
     # death the highest-synced follower promotes. factor=1 disables.

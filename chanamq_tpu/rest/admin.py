@@ -325,12 +325,20 @@ class AdminServer:
         if svc is not None:
             out = svc.health()
         else:
+            from ..telemetry.health import shard_check
+
             draining = bool(getattr(self.broker, "draining", False))
+            reasons = (["draining: shutdown in progress"]
+                       if draining else [])
+            checks: dict = {"draining": {"ok": not draining}}
+            # shard-sibling liveness needs no telemetry, only membership
+            shards = shard_check(self.broker)
+            if shards is not None:
+                checks["shards"], shard_reasons = shards
+                reasons.extend(shard_reasons)
             out = {"node": self.broker.trace_node, "live": True,
-                   "ready": not draining,
-                   "reasons": (["draining: shutdown in progress"]
-                               if draining else []),
-                   "checks": {"draining": {"ok": not draining}}}
+                   "ready": not reasons, "reasons": reasons,
+                   "checks": checks}
         if query.get("scope") == "cluster" and svc is not None:
             payload = await svc.cluster_payload(1)
             out["cluster"] = {
@@ -481,6 +489,7 @@ class AdminServer:
         "telemetry_ticks", "telemetry_saturated_ticks",
         "telemetry_evicted_entities", "telemetry_dropped_entities",
         "alerts_fired", "alerts_resolved",
+        "shard_cross_pushes", "shard_handoffs", "shard_restarts",
     })
 
     @staticmethod
@@ -494,6 +503,12 @@ class AdminServer:
         log lines)."""
         out: list[str] = []
         snap = self.broker.metrics_snapshot()
+        # on a sharded node every worker scrapes the same metric names;
+        # the shard label keeps the per-process series distinguishable
+        shard_info = getattr(self.broker, "shard_info", None)
+        shard_suffix = (
+            f'{{shard="{self._prom_label(str(shard_info["index"]))}"}}'
+            if shard_info else "")
         for key, value in snap.items():
             if isinstance(value, bool):
                 value = int(value)  # e.g. memory_blocked -> 0/1 gauge
@@ -501,7 +516,7 @@ class AdminServer:
                 continue  # None percentiles before any traffic
             kind = "counter" if key in self._PROM_COUNTERS else "gauge"
             out.append(f"# TYPE chanamq_{key} {kind}")
-            out.append(f"chanamq_{key} {value}")
+            out.append(f"chanamq_{key}{shard_suffix} {value}")
         # proper cumulative histogram series: the stored buckets are
         # per-bound counts, so emit a running sum with +Inf last
         for name, hist in self.broker.metrics.histograms().items():
@@ -680,6 +695,8 @@ class AdminServer:
             "alive": cluster.membership.alive_members(),
             "known_queues": len(cluster.queue_metas),
             "owned_queues": owned,
+            "shard": getattr(self.broker, "shard_info", None),
+            "shard_siblings": dict(cluster.uds_map),
             "replication": (
                 {"enabled": False} if cluster.replication is None else {
                     "enabled": True,
@@ -700,8 +717,9 @@ class AdminServer:
         m = self.broker.metrics
         return {
             "peers": {
-                peer: plane.stats()
-                for peer, plane in cluster._dataplanes.items()
+                # keys are (peer, transport kind); JSON wants strings
+                f"{peer}#{kind}": plane.stats()
+                for (peer, kind), plane in cluster._dataplanes.items()
             },
             "control": {
                 name: client.backoff_state()

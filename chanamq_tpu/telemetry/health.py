@@ -17,6 +17,26 @@ if TYPE_CHECKING:  # pragma: no cover
     from .service import TelemetryService
 
 
+def shard_check(broker: "Broker") -> "tuple[dict, list[str]] | None":
+    """Shard-sibling liveness, usable with or without telemetry: a worker
+    in a multi-process node is only ready while every sibling shard
+    heartbeats (a dead sibling means part of the queue space is mid-
+    re-hash; the LB should drain this node). None when not sharded."""
+    shard_info = getattr(broker, "shard_info", None)
+    cluster = broker.cluster
+    if (shard_info is None or cluster is None
+            or cluster.membership is None):
+        return None
+    siblings = set(cluster.uds_map)
+    alive_set = set(cluster.membership.alive_members())
+    dead = sorted(siblings - alive_set)
+    check = {"ok": not dead, "self": shard_info["index"],
+             "count": shard_info["count"], "dead_siblings": dead}
+    reasons = ([f"shard sibling(s) down: {', '.join(dead)}"]
+               if dead else [])
+    return check, reasons
+
+
 def evaluate_health(broker: "Broker", svc: "TelemetryService") -> dict:
     reasons: list[str] = []
     checks: dict[str, dict] = {}
@@ -68,6 +88,11 @@ def evaluate_health(broker: "Broker", svc: "TelemetryService") -> dict:
         if not quorate:
             reasons.append(
                 f"cluster quorum lost ({len(alive)}/{total_n} alive)")
+
+    shards = shard_check(broker)
+    if shards is not None:
+        checks["shards"], shard_reasons = shards
+        reasons.extend(shard_reasons)
 
     return {
         "node": broker.trace_node,

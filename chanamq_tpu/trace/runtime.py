@@ -39,6 +39,7 @@ STAGES = (
     "remote-apply",     # owner-side decode + push_local
     "deliver",          # render + write toward the consumer
     "settle",           # ack/drop (or delivery for no-ack consumers)
+    "intra-shard-hop",  # UDS hop between sibling shards on one node
 )
 INGRESS_PARSE = 0
 ROUTE = 1
@@ -49,6 +50,7 @@ FLUSH_WAIT = 5
 REMOTE_APPLY = 6
 DELIVER = 7
 SETTLE = 8
+INTRA_SHARD_HOP = 9
 
 STAGE_KEYS = tuple("trace_" + s.replace("-", "_") + "_us" for s in STAGES)
 

@@ -130,6 +130,12 @@ class Metrics:
         self.telemetry_dropped_entities = 0
         self.alerts_fired = 0
         self.alerts_resolved = 0
+        # multi-process sharding (chanamq_tpu/shard/): cross-shard UDS
+        # pushes, ownership re-hashes observed on sibling death, and the
+        # restart generation the supervisor hands a respawned worker.
+        self.shard_cross_pushes = 0
+        self.shard_handoffs = 0
+        self.shard_restarts = 0
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -216,6 +222,9 @@ class Metrics:
             "telemetry_saturated_ticks": self.telemetry_saturated_ticks,
             "telemetry_evicted_entities": self.telemetry_evicted_entities,
             "telemetry_dropped_entities": self.telemetry_dropped_entities,
+            "shard_cross_pushes": self.shard_cross_pushes,
+            "shard_handoffs": self.shard_handoffs,
+            "shard_restarts": self.shard_restarts,
             "alerts_fired": self.alerts_fired,
             "alerts_resolved": self.alerts_resolved,
         }
