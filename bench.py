@@ -44,6 +44,10 @@ SPECS = {
     # reference's four spec files
     "transient_autoack_3p3c": (True, False, 3, 3),
     "transient_ack_3p3c": (False, False, 3, 3),
+    # same-topology transient twins of the persistent specs: the honest
+    # denominators for the WAL overhead ratio (--wal)
+    "transient_autoack_3p1c": (True, False, 3, 1),
+    "transient_ack_3p1c": (False, False, 3, 1),
     "persistent_autoack_3p1c": (True, True, 3, 1),
     "persistent_ack_3p1c": (False, True, 3, 1),
 }
@@ -345,6 +349,8 @@ def run_spec(name: str, rate: int = 0,
                 os.unlink(store_file)
             except OSError:
                 pass
+            # the WAL engine keeps its segments beside the SQLite file
+            shutil.rmtree(store_file + ".wal", ignore_errors=True)
     if broker.returncode not in (0, -15):
         errors.append(f"broker rc={broker.returncode}")
     if errors:
@@ -375,6 +381,121 @@ def run_spec(name: str, rate: int = 0,
         "p50_us": round(max(p50s), 1) if p50s else None,
         "p99_us": round(max(p99s), 1) if p99s else None,
         "wall_s": round(elapsed, 2),
+    }
+
+
+def _spawn_store_broker(port: int, store_path: str, env: dict, log_file):
+    return subprocess.Popen(
+        [sys.executable, "-m", "chanamq_tpu.broker.server",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--no-admin", "--log-level", "WARNING", "--store", store_path],
+        env=env, stdout=log_file, stderr=log_file)
+
+
+def run_wal_recovery_smoke(kill_after_confirms: int = 200,
+                           batch: int = 25) -> dict:
+    """The kill-9 durability drill: publish persistent messages with
+    confirms against a WAL-backed broker subprocess, SIGKILL it mid-stream
+    (unconfirmed batch in flight), restart on the same store, drain the
+    queue — every confirmed message must come back. The confirmed set is
+    exact because a batch only enters it after its last confirm arrived,
+    and a WAL confirm means the group commit fsynced it."""
+    from chanamq_tpu.amqp.properties import BasicProperties
+    from chanamq_tpu.client import AMQPClient
+
+    tmpdir = tempfile.mkdtemp(prefix="bench-walrec-")
+    store_path = os.path.join(tmpdir, "broker.db")
+    port = free_port()
+    env = {**os.environ,
+           "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))}
+    log_file = open(os.path.join(tmpdir, "broker.log"), "ab")
+    broker = _spawn_store_broker(port, store_path, env, log_file)
+    confirmed: list[bytes] = []
+    in_flight = 0
+    persistent = BasicProperties(delivery_mode=2)
+
+    async def publish_until_killed() -> None:
+        nonlocal in_flight
+        conn = await AMQPClient.connect("127.0.0.1", port)
+        try:
+            ch = await conn.channel()
+            await ch.confirm_select()
+            await ch.queue_declare("walq", durable=True)
+            i = 0
+            while i < 100_000:
+                bodies = [b"w%06d" % (i + j) for j in range(batch)]
+                try:
+                    in_flight = len(bodies)
+                    for body in bodies:
+                        ch.basic_publish(body, routing_key="walq",
+                                         properties=persistent)
+                    if len(confirmed) >= kill_after_confirms:
+                        # the batch above is on the wire, unconfirmed:
+                        # the kill lands mid-publish by construction
+                        broker.kill()
+                    await ch.wait_unconfirmed_below(1, timeout=10)
+                except Exception:
+                    return  # connection died with the broker
+                confirmed.extend(bodies)
+                in_flight = 0
+                i += batch
+        finally:
+            try:
+                await conn.close()
+            except Exception:
+                pass
+
+    async def drain() -> set:
+        conn = await AMQPClient.connect("127.0.0.1", port)
+        try:
+            ch = await conn.channel()
+            await ch.basic_qos(prefetch_count=PREFETCH)
+            got: set = set()
+            event = asyncio.Event()
+
+            def on_msg(msg):
+                got.add(bytes(msg.body))
+                event.set()
+
+            await ch.basic_consume("walq", on_msg, no_ack=True)
+            while True:
+                event.clear()
+                try:
+                    await asyncio.wait_for(event.wait(), 2.0)
+                except asyncio.TimeoutError:
+                    return got
+        finally:
+            try:
+                await conn.close()
+            except Exception:
+                pass
+
+    t_recover = None
+    try:
+        wait_port(port)
+        asyncio.run(publish_until_killed())
+        broker.kill()
+        broker.wait()
+
+        t0 = time.perf_counter()
+        broker = _spawn_store_broker(port, store_path, env, log_file)
+        wait_port(port)
+        t_recover = time.perf_counter() - t0
+        delivered = asyncio.run(drain())
+    finally:
+        broker.kill()
+        broker.wait()
+        log_file.close()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    missing = sorted(b.decode() for b in set(confirmed) - delivered)
+    return {
+        "confirmed": len(confirmed),
+        "in_flight_at_kill": in_flight,
+        "delivered": len(delivered),
+        "lost_confirmed": len(missing),
+        "lost_first": missing[:5],
+        "recover_s": round(t_recover, 2) if t_recover is not None else None,
     }
 
 
@@ -1131,20 +1252,97 @@ def main() -> None:
             sys.exit(1)  # the tier-1 smoke must fail loudly
         return
 
+    if "--wal-recovery" in sys.argv:
+        # kill-9 durability smoke: any confirmed-message loss exits 1
+        result = run_wal_recovery_smoke()
+        print(f"# wal_recovery: {result}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "wal_recovery_lost_confirmed",
+            "value": result["lost_confirmed"],
+            "unit": "messages",
+            "vs_baseline": None,
+            "wal_recovery": result,
+        }))
+        if result["lost_confirmed"] or result["confirmed"] == 0:
+            sys.exit(1)
+        return
+
+    if "--wal" in sys.argv:
+        # the WAL delta, measured three ways per ack mode: persistent with
+        # the WAL group commit (default), persistent store-direct
+        # (CHANAMQ_WAL_ENABLED=false — the pre-WAL baseline), and the
+        # matching transient spec the acceptance ratio is taken against;
+        # plus the paced persistent p99 with and without the WAL
+        direct = {"CHANAMQ_WAL_ENABLED": "false"}
+        pairs = {
+            "persistent_autoack_3p1c": "transient_autoack_3p1c",
+            "persistent_ack_3p1c": "transient_ack_3p1c",
+        }
+        runs: dict = {}
+        ratios: dict = {}
+        for name, twin in pairs.items():
+            runs[name] = run_spec(name)
+            print(f"# {name}: {runs[name]}", file=sys.stderr)
+            runs[name + "_store_direct"] = run_spec(name, extra_env=direct)
+            print(f"# {name}_store_direct: "
+                  f"{runs[name + '_store_direct']}", file=sys.stderr)
+            runs[twin] = run_spec(twin)
+            print(f"# {twin}: {runs[twin]}", file=sys.stderr)
+            got = runs[name].get("delivered_per_s")
+            base = runs[twin].get("delivered_per_s")
+            ratios[name] = (round(got / base, 3)
+                            if got and base else None)
+        rate_base = runs["persistent_autoack_3p1c"].get("published_per_s")
+        if rate_base:
+            rate = max(1000, int(rate_base * 0.25))
+            runs[PACED_PERSISTENT_SPEC] = run_spec(
+                PACED_PERSISTENT_SPEC, rate=rate)
+            runs[PACED_PERSISTENT_SPEC]["rate"] = rate
+            runs[PACED_PERSISTENT_SPEC + "_store_direct"] = run_spec(
+                PACED_PERSISTENT_SPEC, rate=rate, extra_env=direct)
+            runs[PACED_PERSISTENT_SPEC + "_store_direct"]["rate"] = rate
+            for label in (PACED_PERSISTENT_SPEC,
+                          PACED_PERSISTENT_SPEC + "_store_direct"):
+                print(f"# {label}: {runs[label]}", file=sys.stderr)
+        errors = {n: r["error"] for n, r in runs.items() if "error" in r}
+        print(json.dumps({
+            "metric": "wal_persistent_vs_transient_ratio",
+            "value": ratios.get("persistent_ack_3p1c"),
+            "unit": "ratio",
+            "vs_baseline": None,
+            "ratios": ratios,
+            "paced_persistent_p99_us":
+                runs.get(PACED_PERSISTENT_SPEC, {}).get("p99_us"),
+            "paced_persistent_p99_us_store_direct":
+                runs.get(PACED_PERSISTENT_SPEC + "_store_direct",
+                         {}).get("p99_us"),
+            "body_bytes": BODY_BYTES,
+            "seconds": BENCH_SECONDS,
+            "specs": runs,
+            **({"error": errors} if errors else {}),
+        }))
+        if errors:
+            sys.exit(1)
+        return
+
     if "--chaos" in sys.argv:
-        # seeded chaos soak: the 2-node workload of chanamq_tpu/chaos/soak.py
-        # under the default fault plan (partition + owner crash + slow
-        # store). Same seed -> same plan fingerprint and fault schedule;
-        # any invariant violation exits non-zero so tier-1 gates on it.
+        # seeded chaos soak: the 3-node RF=2 workload of
+        # chanamq_tpu/chaos/soak.py under the default fault plan
+        # (partition + owner crash + slow store), with every node's store
+        # WAL-fronted (CHAOS_WAL=0 reverts to MemoryStore) so confirms
+        # gate on the real group-fsync engine. Same seed -> same plan
+        # fingerprint and fault schedule; any invariant violation exits
+        # non-zero so tier-1 gates on it.
         seed = 42
         if "--seed" in sys.argv:
             seed = int(sys.argv[sys.argv.index("--seed") + 1])
         messages = int(os.environ.get("CHAOS_MESSAGES", "160"))
+        wal = os.environ.get("CHAOS_WAL", "1") != "0"
         from chanamq_tpu.chaos.soak import run_soak
 
         try:
             result = asyncio.run(asyncio.wait_for(
-                run_soak(seed, messages=messages), timeout=150))
+                run_soak(seed, messages=messages, wal=wal), timeout=150))
         except Exception as exc:
             result = {"seed": seed,
                       "violations": [f"{type(exc).__name__}: {exc}"]}

@@ -195,6 +195,26 @@ class BrokerServer:
             store = SqliteStore(
                 store_path,
                 synchronous=config.str("chana.mq.store.synchronous"))
+            if config.bool("chana.mq.wal.enabled"):
+                from ..wal import WalStore
+
+                store = WalStore(
+                    store,
+                    flush_ms=float(config.get("chana.mq.wal.flush-ms")),
+                    flush_bytes=config.size_bytes(
+                        "chana.mq.wal.flush-bytes") or (1 << 20),
+                    segment_bytes=config.size_bytes(
+                        "chana.mq.wal.segment-bytes") or (64 << 20),
+                    sync=config.str("chana.mq.wal.sync"),
+                    checkpoint_ms=float(
+                        config.get("chana.mq.wal.checkpoint-ms")),
+                    memtable_bytes=config.size_bytes(
+                        "chana.mq.wal.memtable-bytes") or (64 << 20),
+                    tier_keep_segments=config.int(
+                        "chana.mq.wal.tier-keep-segments"),
+                    compact_streams=config.bool(
+                        "chana.mq.wal.compact-streams"),
+                )
         ssl_context = None
         tls_port = None
         if config.bool("chana.mq.amqp.amqps.enabled"):
@@ -234,6 +254,10 @@ class BrokerServer:
             stream_delivery_batch=config.int(
                 "chana.mq.stream.delivery-batch") or 128,
         )
+        if store is not None and hasattr(store, "metrics"):
+            # the WAL engine's wal_* counters must land in the broker
+            # registry (Prometheus / admin metrics), not a placeholder
+            store.metrics = broker.metrics
         return cls(
             broker=broker,
             host=config.str("chana.mq.amqp.interface"),

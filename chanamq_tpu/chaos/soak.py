@@ -1,4 +1,4 @@
-"""The chaos soak: a 2-node workload under a seeded fault plan.
+"""The chaos soak: a 3-node replicated workload under a seeded fault plan.
 
 Shared by ``bench.py --chaos`` and ``tests/test_chaos.py`` so the tier-1
 smoke and the test suite assert the same invariants:
@@ -25,12 +25,17 @@ smoke and the test suite assert the same invariants:
    same series every run and the firing set is exact, like the fault
    schedule itself.
 
-Topology: nodes A and B with private MemoryStores, replicate factor 2,
-sync confirms. Queue ``rq`` is owned by A but published AND consumed via
-B, so every message crosses the data plane twice (push B->A, deliver
-A->B) and every confirm gates on A's mutation-log ship back to B. Mid-run
-a crash rule kills A; B must promote its replica and finish the workload
-locally. The stream queue lives on B and survives the crash.
+Topology: three nodes A, B, C with private stores (MemoryStore by
+default; ``wal=True`` gives every node a WAL-fronted SQLite store so the
+group-fsync confirm gate sits in the durability path under chaos),
+replicate factor 2, sync confirms. Queue ``rq`` is owned by A with its
+replica placed on B, but published AND consumed via B, so every message
+crosses the data plane twice (push B->A, deliver A->B) and every confirm
+gates on A's mutation-log ship back to B. Mid-run a crash rule kills A;
+B must promote its replica and finish the workload locally while C looks
+on — exactly one promotion cluster-wide (the replica holder), but BOTH
+survivors observe the DOWN and re-hash the ring once each. The stream
+queue lives on B (replica on C) and survives the crash.
 
 Determinism: the publisher consults the plan once per message at the
 ``soak.tick`` site, so the crash fires at a fixed publish index for a
@@ -81,16 +86,23 @@ def default_plan(seed: int, owner: str, messages: int) -> FaultPlan:
 async def run_soak(
     seed: int, *, messages: int = 160, stream_records: int = 40,
     plan: Optional[FaultPlan] = None, metrics_sink=None,
-    uds: bool = False,
+    uds: bool = False, wal: bool = False,
 ) -> dict:
     """Run the workload under the plan; returns a report whose
     ``violations`` list is empty iff every invariant held.
 
     ``uds=True`` runs the interconnect over Unix-domain sockets — the
     exact transport sibling shards use (shard/) — so the crash becomes
-    the shard-crash drill: same plan, same invariants, plus
-    exactly-one ownership re-hash observed by the survivor."""
+    the shard-crash drill: same plan, same invariants, plus ownership
+    re-hashes observed by each survivor.
+
+    ``wal=True`` backs every node with a WAL-fronted SQLite store
+    (wal/engine.py over a private temp dir): confirms then gate on the
+    cross-channel group fsync, and the slow-store rule stalls the WAL
+    commit barrier itself — proving the no-confirmed-loss invariant with
+    the real durability engine in the path, not a memory stand-in."""
     import os
+    import shutil
     import tempfile
 
     from ..amqp.properties import BasicProperties
@@ -102,10 +114,22 @@ async def run_soak(
     from ..telemetry.alerts import default_rules as alert_defaults
 
     uds_dir = tempfile.mkdtemp(prefix="chanamq-soak-") if uds else None
+    wal_dir = tempfile.mkdtemp(prefix="chanamq-soak-wal-") if wal else None
+    wal_count = 0
+
+    def make_store():
+        if not wal:
+            return MemoryStore()
+        nonlocal wal_count
+        from ..store.sqlite import SqliteStore
+        from ..wal import WalStore
+        wal_count += 1
+        path = os.path.join(wal_dir, f"node{wal_count}.db")
+        return WalStore(SqliteStore(path), flush_ms=1.0, checkpoint_ms=200.0)
 
     async def start_node(seeds, uds_path=None):
         srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
-                           store=MemoryStore())
+                           store=make_store())
         await srv.start()
         cl = ClusterNode(srv.broker, "127.0.0.1", 0, seeds,
                          heartbeat_interval_s=0.2, failure_timeout_s=1.5,
@@ -125,31 +149,37 @@ async def run_soak(
                 repl_lag=1e12, loop_lag_ms=1e12))
         return srv, cl
 
-    a_srv = a_cl = b_srv = b_cl = None
+    a_srv = a_cl = b_srv = b_cl = c_srv = c_cl = None
     conns: list = []
     violations: list[str] = []
     try:
         a_path = os.path.join(uds_dir, "a.sock") if uds_dir else None
         b_path = os.path.join(uds_dir, "b.sock") if uds_dir else None
+        c_path = os.path.join(uds_dir, "c.sock") if uds_dir else None
         a_srv, a_cl = await start_node([], uds_path=a_path)
         b_srv, b_cl = await start_node([a_cl.name], uds_path=b_path)
+        c_srv, c_cl = await start_node([a_cl.name], uds_path=c_path)
         if uds:
             # ephemeral cluster ports: names exist only after start, so
             # the sibling map is patched in afterwards (real shards use
             # fixed base+index ports and get the map at construction)
-            a_cl.uds_map[b_cl.name] = b_path
-            b_cl.uds_map[a_cl.name] = a_path
+            for cl, path in ((a_cl, a_path), (b_cl, b_path), (c_cl, c_path)):
+                for other, opath in ((a_cl, a_path), (b_cl, b_path),
+                                     (c_cl, c_path)):
+                    if other is not cl:
+                        cl.uds_map[other.name] = opath
+        clusters = (a_cl, b_cl, c_cl)
         for _ in range(100):
-            if (len(a_cl.membership.alive_members()) == 2
-                    and len(b_cl.membership.alive_members()) == 2):
+            if all(len(cl.membership.alive_members()) == 3
+                   for cl in clusters):
                 break
             await asyncio.sleep(0.05)
         else:
-            raise RuntimeError("2-node membership did not converge")
+            raise RuntimeError("3-node membership did not converge")
 
-        # -- health gate (invariant 6a): both nodes ready before any load
+        # -- health gate (invariant 6a): all nodes ready before any load
         health_gate: dict[str, bool] = {}
-        for srv, cl in ((a_srv, a_cl), (b_srv, b_cl)):
+        for srv, cl in ((a_srv, a_cl), (b_srv, b_cl), (c_srv, c_cl)):
             srv.broker.telemetry.sample_tick(1.0)
             health = srv.broker.telemetry.health()
             health_gate[cl.name] = health["ready"]
@@ -158,10 +188,18 @@ async def run_soak(
                     f"health gate: {cl.name} not ready before load: "
                     f"{health['reasons']}")
 
-        rq = next(f"cq{i}" for i in range(200)
-                  if a_cl.queue_owner("/", f"cq{i}") == a_cl.name)
-        sq = next(f"cs{i}" for i in range(200)
-                  if a_cl.queue_owner("/", f"cs{i}") == b_cl.name)
+        # placement is pinned, not just ownership: rq's replica must sit
+        # on B (the consumer's node) so the crash promotes where the
+        # consumer already is, and sq's on C so the stream's sync-confirm
+        # path never gates on the dead node
+        def placed(prefix, owner, replica):
+            return next(
+                f"{prefix}{i}" for i in range(2000)
+                if a_cl.ring.preference_entity("q", "/", f"{prefix}{i}", 2)
+                == [owner.name, replica.name])
+
+        rq = placed("cq", a_cl, b_cl)
+        sq = placed("cs", b_cl, c_cl)
 
         if plan is None:
             plan = default_plan(seed, a_cl.name, messages)
@@ -171,6 +209,7 @@ async def run_soak(
         # barrier); the lazy shim keeps them live across install/clear
         a_srv.broker.store = ChaosStore(a_srv.broker.store, _LazyRuntime())
         b_srv.broker.store = ChaosStore(b_srv.broker.store, _LazyRuntime())
+        c_srv.broker.store = ChaosStore(c_srv.broker.store, _LazyRuntime())
 
         crashed = asyncio.Event()
 
@@ -276,7 +315,7 @@ async def run_soak(
         want = {f"m{i:06d}" for i in confirmed}
 
         def surviving_queue():
-            for srv in (b_srv, a_srv):
+            for srv in (b_srv, c_srv, a_srv):
                 if srv is None:
                     continue
                 vhost = srv.broker.vhosts.get("/")
@@ -314,13 +353,17 @@ async def run_soak(
 
         # -- promotion accounting (A's metrics survive its stop)
         promotions = (a_srv.broker.metrics.repl_promotions
-                      + b_srv.broker.metrics.repl_promotions)
+                      + b_srv.broker.metrics.repl_promotions
+                      + c_srv.broker.metrics.repl_promotions)
         # ownership re-hash accounting: each DOWN event a node observes
-        # re-hashes the ring once and bumps shard_handoffs; with 2 nodes
-        # only the survivor can observe the crash, so a crash run must
-        # show exactly one re-hash cluster-wide and a clean run none
+        # re-hashes the ring once and bumps shard_handoffs; with 3 nodes
+        # BOTH survivors observe the crash (one re-hash each), but only
+        # the replica holder (B) promotes — so a crash run must show
+        # exactly two re-hashes and exactly one promotion cluster-wide,
+        # and a clean run none of either
         handoffs = (a_srv.broker.metrics.shard_handoffs
-                    + b_srv.broker.metrics.shard_handoffs)
+                    + b_srv.broker.metrics.shard_handoffs
+                    + c_srv.broker.metrics.shard_handoffs)
         expect_crash = any(r.kind == "crash" for r in plan.rules)
         if expect_crash:
             if not crashed.is_set():
@@ -328,9 +371,10 @@ async def run_soak(
             if promotions != 1:
                 violations.append(
                     f"expected exactly 1 promotion, saw {promotions}")
-            if handoffs != 1:
+            if handoffs != 2:
                 violations.append(
-                    f"expected exactly 1 ownership re-hash, saw {handoffs}")
+                    f"expected exactly 2 ownership re-hashes "
+                    f"(one per survivor), saw {handoffs}")
         else:
             if promotions:
                 violations.append(f"unexpected promotion(s): {promotions}")
@@ -353,6 +397,9 @@ async def run_soak(
         return {
             "seed": seed,
             "fingerprint": fingerprint,
+            "nodes": 3,
+            "store": "wal+sqlite" if wal else "memory",
+            "replicate_factor": 2,
             "messages": messages,
             "confirmed": len(confirmed),
             "publish_attempts": attempts,
@@ -377,12 +424,14 @@ async def run_soak(
                 await conn.close()
             except Exception:
                 pass
-        for part in (b_cl, b_srv, a_cl, a_srv):
+        for part in (c_cl, c_srv, b_cl, b_srv, a_cl, a_srv):
             if part is not None:
                 try:
                     await part.stop()
                 except Exception:
                     pass
+        if wal_dir is not None:
+            shutil.rmtree(wal_dir, ignore_errors=True)
 
 
 # the scripted alert phase must fire exactly these rules, every run

@@ -87,6 +87,37 @@ DEFAULTS: dict[str, Any] = {
     # replay); FULL additionally fsyncs every group commit so confirmed
     # messages survive power loss, at a persistent-throughput cost
     "chana.mq.store.synchronous": "NORMAL",
+    # write-ahead log engine (chanamq_tpu/wal/): when a store path is set,
+    # durable mutations append to a per-shard segment log whose commit loop
+    # batches ONE fsync across all channels/queues/subsystems per flush
+    # window; SQLite becomes the read index, drained by a background
+    # checkpointer. false = store-direct (PR 1-7 behavior).
+    "chana.mq.wal.enabled": True,
+    # group-commit window: an append waits at most this long for peers to
+    # share its fsync (latency floor for awaited durable ops and confirms)
+    "chana.mq.wal.flush-ms": 2,
+    # cut the window early once this many bytes are buffered
+    "chana.mq.wal.flush-bytes": "1MiB",
+    # active segment seals at this size; sealed segments are truncated
+    # whole once the checkpoint covers them
+    "chana.mq.wal.segment-bytes": "64MiB",
+    # durability tier: "fsync" survives power loss (fsync per group
+    # commit + SQLite checkpoint fsync); "os" leaves commits in the OS
+    # page cache — survives SIGKILL, not power loss — and skips both
+    "chana.mq.wal.sync": "fsync",
+    # checkpoint cadence: drain committed records into the SQLite index,
+    # truncate covered segments, run stream-segment maintenance
+    "chana.mq.wal.checkpoint-ms": 1000,
+    # memtable cap: pending index ops (and their overlay blobs) drain
+    # early once they outgrow this, bounding RAM between checkpoints
+    "chana.mq.wal.memtable-bytes": "64MiB",
+    # tiered offload: keep this many newest sealed stream segments hot in
+    # SQLite; older blobs move to side files (index rows stay, reads
+    # rehydrate). 0 disables offload.
+    "chana.mq.wal.tier-keep-segments": 2,
+    # key compaction for stream queues declared with x-stream-compact:
+    # newest record per routing key survives in sealed segments
+    "chana.mq.wal.compact-streams": True,
     # store-growth gate: when passivation/page-out absorbs a flood, RAM
     # stays flat but the store grows — above this live-data size the
     # publisher gate closes (like the memory watermark), reopening at 80%.

@@ -136,6 +136,10 @@ class StoreService:
     # callable; MemoryStore applies eagerly); the defaults wrap the async
     # variant in a logged task so any backend is correct out of the box.
 
+    # background write failures feed telemetry's store-error window and
+    # the readiness gate; always present so health code reads it directly
+    error_count: int = 0
+
     def _fire(self, aw) -> None:
         """Track a fire-and-forget store write: kept alive in a per-store
         set (an un-referenced task may be GC'd before running), failures

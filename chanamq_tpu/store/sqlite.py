@@ -306,6 +306,11 @@ class SqliteStore(StoreService):
                         self._failed_floor, self._failed_seqs.pop(0))
             if fut is None:  # _submit_nowait op
                 if exc is not None:
+                    # count it, don't just log it: error_count feeds the
+                    # telemetry store-error window and readiness reasons —
+                    # a store silently failing fire-and-forget writes must
+                    # flip /admin/health, not only a log line
+                    self.error_count = getattr(self, "error_count", 0) + 1
                     log.error("background store write failed: %r", exc)
                 continue
             if fut.cancelled():
@@ -828,6 +833,85 @@ class SqliteStore(StoreService):
     def delete_queue_binds(self, vhost, queue):
         return self._submit(lambda db: db.execute(
             "DELETE FROM binds WHERE vhost=? AND queue=?", (vhost, queue)), guard=False)
+
+    # -- WAL engine support (chanamq_tpu/wal/) -----------------------------
+    # The write-ahead wrapper keeps its checkpoint watermark in cluster_kv,
+    # needs a real fsync of the database at checkpoint time, and runs
+    # stream-segment maintenance (key compaction + tier offload) through
+    # blob-level helpers that the plain store API doesn't expose.
+
+    async def get_kv(self, key: str) -> Optional[int]:
+        def q(db: sqlite3.Connection) -> Optional[int]:
+            row = db.execute(
+                "SELECT value FROM cluster_kv WHERE key=?", (key,)).fetchone()
+            return int(row[0]) if row is not None else None
+
+        return await self._submit(q, guard=False)
+
+    def put_kv(self, key: str, value: int):
+        return self._submit(lambda db: db.execute(
+            "INSERT OR REPLACE INTO cluster_kv VALUES (?,?)",
+            (key, value)), guard=False)
+
+    def worker_id_floor(self, n: int):
+        """Replay-only: next_worker_id = max(current, n). WAL recovery uses
+        it so an id allocated just before a crash is never re-issued."""
+        def w(db: sqlite3.Connection) -> None:
+            db.execute(
+                "INSERT OR IGNORE INTO cluster_kv VALUES ('next_worker_id', 0)")
+            db.execute(
+                "UPDATE cluster_kv SET value=? "
+                "WHERE key='next_worker_id' AND value<?", (n, n))
+
+        return self._submit(w)
+
+    async def checkpoint_sync(self) -> None:
+        """fsync the database file. Under synchronous=NORMAL, SQLite only
+        fsyncs at WAL checkpoints — the wrapper calls this before
+        truncating its own segments, so a power cut can't eat index state
+        the WAL no longer covers. A checkpoint cannot run inside a
+        transaction, so this rides the writer executor directly (the
+        single-threaded executor serializes it between group commits)."""
+        db = self._db
+        if db is None:
+            return
+        loop = self._loop or asyncio.get_running_loop()
+        await loop.run_in_executor(
+            self._executor,
+            lambda: db.execute("PRAGMA wal_checkpoint(TRUNCATE)").fetchone())
+
+    async def stream_segment_index(self) -> list:
+        """Whole-store segment index for maintenance sweeps:
+        (vhost, queue, base_offset, size_bytes, has_blob) rows."""
+        rows = await self._submit(lambda db: db.execute(
+            "SELECT vhost, queue, base_offset, size_bytes, "
+            "blob IS NOT NULL FROM stream_segments "
+            "ORDER BY vhost, queue, base_offset").fetchall(), guard=False)
+        return [tuple(r) for r in rows]
+
+    def evict_stream_blob(self, vhost, queue, base_offset):
+        """Tier offload: drop the blob bytes, keep the index row."""
+        return self._submit(lambda db: db.execute(
+            "UPDATE stream_segments SET blob=NULL "
+            "WHERE vhost=? AND queue=? AND base_offset=?",
+            (vhost, queue, base_offset)), guard=False)
+
+    def replace_stream_segment_blob(self, vhost, queue, base_offset,
+                                    blob, size_bytes):
+        """Key compaction: swap a sealed segment's bytes in place (offsets
+        inside the blob are preserved; last_offset stays)."""
+        return self._submit(lambda db: db.execute(
+            "UPDATE stream_segments SET blob=?, size_bytes=? "
+            "WHERE vhost=? AND queue=? AND base_offset=?",
+            (blob, size_bytes, vhost, queue, base_offset)), guard=False)
+
+    async def queue_arguments(self, vhost, name) -> Optional[dict]:
+        row = await self._submit(lambda db: db.execute(
+            "SELECT arguments FROM queue_metas WHERE vhost=? AND name=?",
+            (vhost, name)).fetchone(), guard=False)
+        if row is None:
+            return None
+        return json.loads(row[0] or "{}")
 
     def allocate_worker_id(self):
         # runs inside the batch's BEGIN IMMEDIATE transaction, so the

@@ -31,7 +31,9 @@ from typing import TYPE_CHECKING, Any, Optional, Union
 from ..amqp.properties import BasicProperties
 from ..amqp.value_codec import Timestamp
 from ..broker.entities import Delivery, Message, Queue, QueuedMessage, now_ms
-from .segment import Segment, StreamRecord, pack_records, unpack_records
+from .segment import (
+    Segment, StreamRecord, pack_records, unpack_records_indexed,
+)
 
 if TYPE_CHECKING:  # pragma: no cover
     from ..broker.broker import Broker
@@ -42,6 +44,10 @@ log = logging.getLogger("chanamq.streams")
 # sentinel: the record lives in an evicted sealed segment whose blob is
 # being (re)loaded from the store — the cursor resumes on load completion
 _LOADING = object()
+
+# sentinel: the offset existed but key compaction (chanamq_tpu/wal/)
+# dropped its record from the sealed blob — readers skip to offset+1
+_COMPACTED = object()
 
 # cursor name backing basic.get reads (shares the committed-offset table
 # with consumer cursors, so gets also survive restarts)
@@ -295,7 +301,8 @@ class StreamQueue(Queue):
         if seg.records is None:
             self._start_segment_load(seg)
             return _LOADING
-        return seg.records[offset - seg.base_offset]
+        rec = seg.records[offset - seg.base_offset]
+        return rec if rec is not None else _COMPACTED
 
     def _start_segment_load(self, seg: Segment) -> None:
         if seg.base_offset in self._loading or self.deleted:
@@ -309,7 +316,8 @@ class StreamQueue(Queue):
             blob = await self.broker.store.select_stream_segment(
                 self.vhost, self.name, seg.base_offset)
             if blob is not None and seg.records is None:
-                seg.records = unpack_records(blob)
+                seg.records = unpack_records_indexed(
+                    blob, seg.base_offset, seg.last_offset)
                 self._evict_cache(keep=seg)
         except Exception:
             failed = True
@@ -366,6 +374,11 @@ class StreamQueue(Queue):
                 rec = self._record_at(cursor.next)
                 if rec is None or rec is _LOADING:
                     break
+                if rec is _COMPACTED:
+                    # key compaction dropped this offset from the sealed
+                    # blob; the cursor walks the hole without delivering
+                    cursor.next += 1
+                    continue
                 if cursor.skip_ts_ms is not None:
                     if rec.ts_ms < cursor.skip_ts_ms:
                         cursor.next = rec.offset + 1
@@ -467,21 +480,27 @@ class StreamQueue(Queue):
         if pos is None:
             committed = self.committed.get(GET_CURSOR)
             pos = self.first_offset if committed is None else committed + 1
-        if pos < self.first_offset:
-            pos = self.first_offset
-        rec = self._record_at(pos)
-        if rec is _LOADING:
-            seg = self._find_segment(pos)
-            if seg is None:
-                return None
-            blob = await self.broker.store.select_stream_segment(
-                self.vhost, self.name, seg.base_offset)
-            if self.deleted or blob is None:
-                return None
-            if seg.records is None:
-                seg.records = unpack_records(blob)
-                self._evict_cache(keep=seg)
-            rec = seg.records[pos - seg.base_offset]
+        while True:
+            if pos < self.first_offset:
+                pos = self.first_offset
+            rec = self._record_at(pos)
+            if rec is _LOADING:
+                seg = self._find_segment(pos)
+                if seg is None:
+                    return None
+                blob = await self.broker.store.select_stream_segment(
+                    self.vhost, self.name, seg.base_offset)
+                if self.deleted or blob is None:
+                    return None
+                if seg.records is None:
+                    seg.records = unpack_records_indexed(
+                        blob, seg.base_offset, seg.last_offset)
+                    self._evict_cache(keep=seg)
+                continue  # re-read now that the segment is resident
+            if rec is _COMPACTED:
+                pos += 1  # compaction hole: step to the next offset
+                continue
+            break
         if rec is None:
             return None
         self._get_pos = pos + 1

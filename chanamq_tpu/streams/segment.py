@@ -102,6 +102,27 @@ def pack_records(records: list[StreamRecord]) -> bytes:
     return bytes(out)
 
 
+def unpack_records_indexed(
+    blob: bytes, base_offset: int, last_offset: int
+) -> "list[Optional[StreamRecord]]":
+    """Slot list covering base..last inclusive, indexed by offset-base.
+
+    The record wire format carries explicit offsets, so a blob that key
+    compaction made *sparse* (chanamq_tpu/wal/tier.py) reconstructs with
+    None holes where records were dropped — the read paths index
+    ``records[offset - base_offset]`` and skip the holes, keeping every
+    committed cursor offset valid across compaction.  A dense blob fills
+    every slot and behaves exactly as before.
+    """
+    slots: "list[Optional[StreamRecord]]" = (
+        [None] * (last_offset - base_offset + 1))
+    for rec in unpack_records(blob):
+        idx = rec.offset - base_offset
+        if 0 <= idx < len(slots):
+            slots[idx] = rec
+    return slots
+
+
 def unpack_records(blob: bytes) -> list[StreamRecord]:
     records: list[StreamRecord] = []
     pos = 0

@@ -19,7 +19,8 @@ from typing import Optional
 from .runtime import (  # noqa: F401  (package API)
     CLUSTER_PUSH, DELIVER, ENQUEUE, FLUSH_WAIT, INGRESS_PARSE,
     INTRA_SHARD_HOP, REMOTE_APPLY, REPLICATE_SHIP, ROUTE, SETTLE, STAGE_KEYS,
-    STAGES, Trace, TraceRuntime, decode_trailer, encode_trailer,
+    STAGES, WAL_APPEND, WAL_COMMIT, Trace, TraceRuntime, decode_trailer,
+    encode_trailer,
 )
 
 ACTIVE: Optional[TraceRuntime] = None

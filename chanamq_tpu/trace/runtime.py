@@ -40,6 +40,8 @@ STAGES = (
     "deliver",          # render + write toward the consumer
     "settle",           # ack/drop (or delivery for no-ack consumers)
     "intra-shard-hop",  # UDS hop between sibling shards on one node
+    "wal-append",       # encode + buffer a WAL record (synchronous)
+    "wal-commit",       # the group write+fsync that made it durable
 )
 INGRESS_PARSE = 0
 ROUTE = 1
@@ -51,6 +53,8 @@ REMOTE_APPLY = 6
 DELIVER = 7
 SETTLE = 8
 INTRA_SHARD_HOP = 9
+WAL_APPEND = 10
+WAL_COMMIT = 11
 
 STAGE_KEYS = tuple("trace_" + s.replace("-", "_") + "_us" for s in STAGES)
 

@@ -130,6 +130,29 @@ class Metrics:
         self.telemetry_dropped_entities = 0
         self.alerts_fired = 0
         self.alerts_resolved = 0
+        # write-ahead log engine (chanamq_tpu/wal/): append/commit volume,
+        # checkpoint + recovery accounting, stream-segment tier offload and
+        # key compaction. All zero unless chana.mq.wal.enabled with a store.
+        self.wal_appends = 0
+        self.wal_append_bytes = 0
+        self.wal_commits = 0
+        self.wal_fsyncs = 0
+        self.wal_commit_errors = 0
+        self.wal_segments_sealed = 0
+        self.wal_segments_truncated = 0
+        self.wal_checkpoints = 0
+        self.wal_checkpoint_errors = 0
+        self.wal_recovered_records = 0
+        self.wal_recover_torn = 0
+        self.wal_recover_corrupt = 0
+        self.wal_tier_offloads = 0
+        self.wal_tier_rehydrations = 0
+        self.wal_compactions = 0
+        self.wal_compacted_records = 0
+        self.wal_memtable_drains = 0
+        self.wal_memtable_elided = 0
+        self.wal_memtable_hits = 0
+        self.wal_commit_us = Histogram()
         # multi-process sharding (chanamq_tpu/shard/): cross-shard UDS
         # pushes, ownership re-hashes observed on sibling death, and the
         # restart generation the supervisor hands a respawned worker.
@@ -151,6 +174,7 @@ class Metrics:
         out = {
             "publish_to_deliver_us": self.publish_to_deliver_us,
             "repl_ack_us": self.repl_ack_us,
+            "wal_commit_us": self.wal_commit_us,
         }
         out.update(self.trace_stage_us)
         return out
@@ -225,6 +249,28 @@ class Metrics:
             "shard_cross_pushes": self.shard_cross_pushes,
             "shard_handoffs": self.shard_handoffs,
             "shard_restarts": self.shard_restarts,
+            "wal_appends": self.wal_appends,
+            "wal_append_bytes": self.wal_append_bytes,
+            "wal_commits": self.wal_commits,
+            "wal_fsyncs": self.wal_fsyncs,
+            "wal_commit_errors": self.wal_commit_errors,
+            "wal_segments_sealed": self.wal_segments_sealed,
+            "wal_segments_truncated": self.wal_segments_truncated,
+            "wal_checkpoints": self.wal_checkpoints,
+            "wal_checkpoint_errors": self.wal_checkpoint_errors,
+            "wal_recovered_records": self.wal_recovered_records,
+            "wal_recover_torn": self.wal_recover_torn,
+            "wal_recover_corrupt": self.wal_recover_corrupt,
+            "wal_tier_offloads": self.wal_tier_offloads,
+            "wal_tier_rehydrations": self.wal_tier_rehydrations,
+            "wal_compactions": self.wal_compactions,
+            "wal_compacted_records": self.wal_compacted_records,
+            "wal_memtable_drains": self.wal_memtable_drains,
+            "wal_memtable_elided": self.wal_memtable_elided,
+            "wal_memtable_hits": self.wal_memtable_hits,
+            "wal_commit_p50_us": self.wal_commit_us.percentile_us(0.50),
+            "wal_commit_p99_us": self.wal_commit_us.percentile_us(0.99),
+            "wal_commit_mean_us": self.wal_commit_us.mean_us,
             "alerts_fired": self.alerts_fired,
             "alerts_resolved": self.alerts_resolved,
         }

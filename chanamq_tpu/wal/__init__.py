@@ -1,0 +1,9 @@
+"""Log-structured write-ahead storage engine (ISSUE 8).
+
+``WalStore`` wraps the SQLite store: appends win durability via one
+cross-channel group fsync per flush window, SQLite stays the read index
+fed by a background checkpointer, and recovery replays the WAL tail.
+See :mod:`chanamq_tpu.wal.engine` for the full design notes.
+"""
+
+from .engine import CHECKPOINT_KEY, WalStore  # noqa: F401

@@ -74,6 +74,16 @@ BENCH_SECONDS=5 timeout -k 10 240 python bench.py --shard 2 || {
     exit "$rc"
 }
 
+echo "tier1: WAL kill-9 recovery smoke (confirmed set must survive SIGKILL)"
+# pumps publisher confirms against a WAL-backed broker, SIGKILLs it
+# mid-stream, restarts on the same data dir and asserts every confirmed
+# message is redelivered — a confirm means the group commit fsynced it
+timeout -k 10 120 python bench.py --wal-recovery || {
+    rc=$?
+    echo "tier1: WAL recovery smoke FAILED (rc=$rc) — confirmed messages lost after kill -9" >&2
+    exit "$rc"
+}
+
 echo "tier1: stream bench smoke (5 s)"
 BENCH_SECONDS=5 timeout -k 10 120 python bench.py --stream || {
     rc=$?

@@ -1,7 +1,7 @@
 """Fault-injection subsystem tests: deterministic plans, seam injection,
 the /admin/chaos surface, reconnect backoff (jitter + admin state), the
 mid-batch confirm-chain abort and promotion-during-ship regressions, and
-the full seeded 2-node chaos soak."""
+the full seeded 3-node chaos soak."""
 
 import asyncio
 import json
@@ -540,9 +540,11 @@ async def test_seeded_soak_holds_all_invariants():
     assert report["delivered_unique"] == 80
     assert report["post_settle_duplicates"] == 0
     assert report["stream"]["contiguous"] is True
-    # health gate: both nodes reported ready before load was offered
+    # health gate: all three nodes reported ready before load was offered
     assert all(report["health_gate"].values())
-    assert len(report["health_gate"]) == 2
+    assert len(report["health_gate"]) == 3
+    # the replica holder promotes; both survivors re-hash once each
+    assert report["handoffs"] == 2
     # the scripted alert phase fired exactly the expected rules
     from chanamq_tpu.chaos.soak import EXPECTED_ALERT_RULES
     assert tuple(report["alerts"]["fired_rules"]) == EXPECTED_ALERT_RULES

@@ -458,15 +458,15 @@ async def test_readiness_flags_dead_shard_sibling():
 # ---------------------------------------------------------------------------
 
 
-async def test_soak_uds_no_loss_and_single_rehash():
+async def test_soak_uds_no_loss_and_rehash_per_survivor():
     """The seeded soak with the interconnect on Unix sockets: the default
     plan's owner crash must cost zero confirmed messages and re-hash
-    ownership exactly once."""
+    ownership exactly once on each of the two survivors."""
     from chanamq_tpu.chaos.soak import run_soak
 
     report = await asyncio.wait_for(
         run_soak(42, messages=60, uds=True), timeout=120)
     assert report["violations"] == [], report["violations"]
     assert report["interconnect"] == "uds"
-    assert report["handoffs"] == 1
+    assert report["handoffs"] == 2
     assert report["confirmed"] > 0
