@@ -1363,6 +1363,73 @@ def main() -> None:
             sys.exit(1)  # the tier-1 smoke must fail loudly
         return
 
+    if "--overload" in sys.argv:
+        # overload soak: a deterministic memory-pressure chaos rule drives
+        # the flow ladder to the refuse stage under a saturating publisher
+        # (chanamq_tpu/chaos/soak.py run_overload_soak). Reports the peak
+        # accounted bytes vs the hard limit, paged-body count and the
+        # throttle episode latency; any invariant violation (peak over the
+        # ceiling, confirmed loss, no refusals, no recovery) exits 1.
+        seed = 7
+        if "--seed" in sys.argv:
+            seed = int(sys.argv[sys.argv.index("--seed") + 1])
+        messages = int(os.environ.get("OVERLOAD_MESSAGES", "160"))
+        from chanamq_tpu.chaos.soak import run_overload_soak
+
+        try:
+            result = asyncio.run(asyncio.wait_for(
+                run_overload_soak(seed, messages=messages), timeout=120))
+        except Exception as exc:
+            result = {"seed": seed,
+                      "violations": [f"{type(exc).__name__}: {exc}"]}
+        print(f"# overload_soak: {result}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "overload_peak_accounted_bytes",
+            "value": result.get("peak_accounted_bytes"),
+            "unit": "bytes",
+            "vs_baseline": None,
+            "seed": seed,
+            "hard_limit": result.get("hard_limit"),
+            "under_hard_limit": bool(result.get("under_hard_limit")),
+            "paged_bodies": result.get("paged_bodies"),
+            "publishes_refused": result.get("publishes_refused"),
+            "throttle_latency_s": result.get("throttle_latency_s"),
+            "overload_soak": {k: v for k, v in result.items()
+                              if k != "chaos"},
+        }))
+        if result.get("violations") or not result.get("under_hard_limit"):
+            sys.exit(1)  # the tier-1 smoke must fail loudly
+        return
+
+    if "--churn" in sys.argv:
+        # connection-churn leak check: N connect/declare-exclusive/publish/
+        # disconnect cycles (half abrupt aborts), then the memory
+        # accountant must be back at zero (chanamq_tpu/chaos/soak.py
+        # run_connection_churn). Any leaked accounted byte exits 1.
+        cycles = int(os.environ.get("CHURN_CYCLES", "500"))
+        from chanamq_tpu.chaos.soak import run_connection_churn
+
+        try:
+            result = asyncio.run(asyncio.wait_for(
+                run_connection_churn(cycles), timeout=180))
+        except Exception as exc:
+            result = {"cycles": cycles,
+                      "violations": [f"{type(exc).__name__}: {exc}"]}
+        print(f"# connection_churn: {result}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "churn_leaked_accounted_bytes",
+            "value": result.get("leaked_bytes"),
+            "unit": "bytes",
+            "vs_baseline": None,
+            "cycles": result.get("cycles"),
+            "aborted": result.get("aborted"),
+            "peak_accounted_bytes": result.get("peak_accounted_bytes"),
+            "connection_churn": result,
+        }))
+        if result.get("violations"):
+            sys.exit(1)  # the tier-1 smoke must fail loudly
+        return
+
     if "--cluster" in sys.argv:
         # cluster scenario only: 2 in-process nodes, burst publish via the
         # non-owner + remote consume + paced remote latency — the

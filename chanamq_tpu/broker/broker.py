@@ -20,12 +20,18 @@ import logging
 import time
 from typing import Any, Awaitable, Optional
 
-from .. import trace
+from .. import chaos, trace
 
 from ..amqp.constants import ErrorCode, ExchangeType
 from ..amqp.properties import BasicProperties
 from ..amqp.value_codec import Timestamp
 from ..cluster.idgen import IdGenerator
+from ..flow import (
+    MemoryAccountant,
+    STAGE_PAGE,
+    STAGE_REFUSE,
+    STAGE_THROTTLE,
+)
 from ..store.api import StoredExchange, StoredMessage, StoredQueue, StoreService
 from ..store.memory import MemoryStore
 from ..streams import VALID_QUEUE_TYPES, StreamQueue
@@ -68,6 +74,16 @@ class Broker:
         stream_segment_age_s: float = 10.0,
         stream_cache_segments: int = 4,
         stream_delivery_batch: int = 128,
+        flow_high_watermark: Optional[int] = None,
+        flow_low_watermark: Optional[int] = None,
+        flow_page_watermark: Optional[int] = None,
+        flow_cluster_watermark: Optional[int] = None,
+        flow_hard_limit: Optional[int] = None,
+        flow_refuse_watermark: Optional[int] = None,
+        flow_page_resident: int = 256,
+        flow_publish_credit: int = 0,
+        flow_consumer_buffer: int = 0,
+        park_buffer: Optional[int] = None,
     ) -> None:
         self.store = store or MemoryStore()
         self.idgen = IdGenerator(node_id)
@@ -136,6 +152,40 @@ class Broker:
         # publish bodies held at the gate across all connections (gauge;
         # bounded by PARK_BUF_MAX per connection x max-connections)
         self.held_bytes = 0
+        # overload-protection ladder (chanamq_tpu/flow/): on whenever a
+        # flow or memory high watermark is configured. The accountant's
+        # stage 2 IS the legacy memory gate (blocked == stage>=2 composed
+        # with the store gate); stages 1/3/4 add paging, cluster pushback
+        # and publish refusal around it.
+        self.flow: Optional[MemoryAccountant] = None
+        self.flow_paging = False       # stage >= 1: aggressive page cap live
+        self.flow_refusing = False     # stage >= 4: publishes get 406
+        self.flow_page_resident = flow_page_resident or 0
+        self.flow_page_resident_active = 0  # flow_page_resident while paging
+        self.flow_publish_credit = flow_publish_credit or 0
+        self.flow_consumer_buffer = flow_consumer_buffer or 0
+        # per-connection park-buffer override (0: connection class default)
+        self.park_buf_max = park_buffer or 0
+        # fired as fn(old_stage, new_stage) after broker-side actuation
+        # (connections send channel.flow, the cluster shrinks credit)
+        self.flow_stage_listeners: set[Any] = set()
+        fhw = flow_high_watermark or self.memory_high_watermark
+        if fhw:
+            # when the flow watermark is the derived memory watermark, the
+            # low watermark must follow it too so stage 2 keeps the exact
+            # legacy block/unblock boundaries
+            flw = flow_low_watermark
+            if flw is None and fhw == self.memory_high_watermark:
+                flw = self.memory_low_watermark
+            self.flow = MemoryAccountant(
+                high_watermark=fhw,
+                low_watermark=flw,
+                page_watermark=flow_page_watermark,
+                cluster_watermark=flow_cluster_watermark,
+                hard_limit=flow_hard_limit,
+                refuse_watermark=flow_refuse_watermark,
+            )
+            self.flow.listeners.append(self._on_flow_stage)
         self.blocked = False
         self.blocked_reason = ""  # wire-visible cause (Connection.Blocked)
         self._mem_over = False    # resident_bytes above the RAM watermark
@@ -201,8 +251,16 @@ class Broker:
     def account_memory(self, delta: int) -> None:
         """Track resident message-body bytes (passivation drops, hydration
         reloads, publish adds, final unrefer releases) and drive the
-        publisher-backpressure gate off the gauge."""
+        overload ladder — whose throttle stage is the publisher gate —
+        off the gauge."""
         self.resident_bytes += delta
+        flow = self.flow
+        if flow is not None:
+            flow.components["bodies"] = self.resident_bytes
+            flow.reevaluate()
+            return
+        # no flow accountant (no watermark configured anywhere): legacy
+        # binary-gate bookkeeping, inert unless memory_high_watermark set
         if not self.memory_high_watermark:
             return
         if not self._mem_over and self.resident_bytes > self.memory_high_watermark:
@@ -211,6 +269,38 @@ class Broker:
         elif self._mem_over and self.resident_bytes <= self.memory_low_watermark:
             self._mem_over = False
             self._update_gate()
+
+    def account_held(self, delta: int) -> None:
+        """Track publish bodies parked at the gate (connection hold/release/
+        teardown). A separate gauge from resident_bytes — holds must never
+        feed back into the gate that created them — but a real resident
+        cost the flow accountant sums toward the harder stages."""
+        self.held_bytes += delta
+        flow = self.flow
+        if flow is not None:
+            flow.components["held"] = self.held_bytes
+            flow.reevaluate()
+
+    def _on_flow_stage(self, old: int, new: int) -> None:
+        """Broker-side ladder actuation, then fan out to the registered
+        connection/cluster listeners."""
+        if new > old:
+            self.metrics.flow_escalations += 1
+        else:
+            self.metrics.flow_deescalations += 1
+        self.flow_paging = new >= STAGE_PAGE
+        self.flow_page_resident_active = (
+            self.flow_page_resident if self.flow_paging else 0)
+        self.flow_refusing = new >= STAGE_REFUSE
+        mem_over = new >= STAGE_THROTTLE
+        if mem_over != self._mem_over:
+            self._mem_over = mem_over
+            self._update_gate()
+        for listener in list(self.flow_stage_listeners):
+            try:
+                listener(old, new)
+            except Exception:
+                log.exception("flow stage listener failed")
 
     def _update_gate(self) -> None:
         """Recompute the publisher gate from its component watermarks
@@ -274,6 +364,15 @@ class Broker:
         snap["queue_depth"] = self.queue_depth
         snap["queue_unacked"] = self.queue_unacked
         snap["queue_consumers"] = self.queue_consumers
+        if self.flow is not None:
+            flow = self.flow
+            snap["flow_stage"] = flow.stage
+            snap["flow_stage_label"] = flow.label
+            snap["flow_total_bytes"] = flow.total
+            snap["flow_peak_bytes"] = flow.peak_total
+            snap["flow_hard_limit"] = flow.hard_limit
+            for name, value in flow.components.items():
+                snap[f"flow_bytes_{name}"] = value
         if self.cluster is not None and self.cluster.replication is not None:
             snap["repl_lag_events"] = self.cluster.replication.total_lag()
         if self.telemetry is not None:
@@ -1613,6 +1712,32 @@ class Broker:
             self._store_over = False
             self._update_gate()
 
+    def _flow_tick(self, stream_cache_bytes: int) -> None:
+        """One sweep-tick sample of the polled accountant components (WAL
+        memtable, data-plane buffers, connection out-buffers, stream sealed
+        cache, chaos inflation), then a single ladder reevaluation. The
+        hot components (bodies, held) are pushed synchronously elsewhere;
+        hooking these cold ones at their mutation sites would tax every
+        WAL append and socket write for sweep-tick-freshness data."""
+        flow = self.flow
+        c = flow.components
+        c["stream_cache"] = stream_cache_bytes
+        c["wal_memtable"] = int(
+            getattr(self.store, "memtable_pending_bytes", 0) or 0)
+        c["cluster_inflight"] = (
+            self.cluster.dataplane_buffered_bytes()
+            if self.cluster is not None else 0)
+        out_buffers = 0
+        for conn in self.connections:
+            out_buffers += len(conn._out)
+        c["out_buffers"] = out_buffers
+        if chaos.ACTIVE is not None:
+            fault = chaos.ACTIVE.decide("flow.tick")
+            c["chaos"] = (
+                fault.inflate_bytes
+                if fault is not None and fault.kind == "pressure" else 0)
+        flow.reevaluate()
+
     # -- TTL sweep ---------------------------------------------------------
 
     async def _sweep_loop(self) -> None:
@@ -1628,16 +1753,26 @@ class Broker:
                 expired_queues: list[Queue] = []
                 overdue_channels: set = set()
                 timeout = self.consumer_timeout_ms
+                stream_cache_bytes = 0
                 for vhost in self.vhosts.values():
                     for queue in vhost.queues.values():
                         before = len(queue.messages)
                         queue._expire_head()
                         self.metrics.expired_msgs += before - len(queue.messages)
+                        if queue.is_stream:
+                            stream_cache_bytes += queue.cache_bytes
+                        elif self.flow_paging:
+                            # stage >= 1: page bodies beyond the pressure
+                            # cap out of queues that aren't receiving
+                            # pushes (the push path handles active ones)
+                            queue.passivate_excess(self.flow_page_resident)
                         # x-expires: the queue itself dies after idling
                         # unused (no consumers, no gets/declares)
                         if (queue.expires_ms and not queue.consumers
                                 and now - queue.last_used >= queue.expires_ms):
                             expired_queues.append(queue)
+                if self.flow is not None:
+                    self._flow_tick(stream_cache_bytes)
                 if timeout:
                     # ack timeout: walk every live connection's channels —
                     # the one registry where every outstanding delivery

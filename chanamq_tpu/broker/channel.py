@@ -39,7 +39,8 @@ class Consumer:
 
     __slots__ = (
         "tag", "channel", "queue", "no_ack", "exclusive", "arguments",
-        "priority", "unacked_count", "unacked_size", "_deliver_prefix",
+        "priority", "unacked_count", "unacked_size", "buffered_bytes",
+        "slow", "_deliver_prefix",
     )
 
     def __init__(
@@ -63,6 +64,12 @@ class Consumer:
         self.priority = int(self.arguments.get("x-priority") or 0)
         self.unacked_count = 0
         self.unacked_size = 0
+        # bounded delivery buffer (chana.mq.flow.consumer-buffer): body
+        # bytes rendered to the connection's output buffer since it last
+        # fully drained to the kernel; `slow` marks a consumer currently
+        # over the bound (detected once per episode, see can_take)
+        self.buffered_bytes = 0
+        self.slow = False
         # precomputed basic.deliver method-payload prefix:
         # class 60, method 60, shortstr consumer-tag
         tag_b = tag.encode("utf-8")
@@ -81,15 +88,30 @@ class Consumer:
         self.channel.connection.notify_consumer_cancel(self.channel, self.tag)
 
     def can_take(self, next_size: int) -> bool:
-        """Prefetch/QoS admission (reference: FrameStage.scala:387-392 +
-        QueueEntity.scala:342-359): no_ack consumers are unbounded; otherwise
-        both the per-consumer and channel-global budgets must have room, and
-        the connection's outbound buffer must not be saturated."""
+        """Unified consumer-credit admission (reference:
+        FrameStage.scala:387-392 + QueueEntity.scala:342-359): every
+        delivery passes the same ordered budget checks — channel flow,
+        connection write saturation, the per-consumer bounded delivery
+        buffer (slow-consumer detection), then the basic.qos prefetch
+        count/size budgets (per-consumer and channel-global, with
+        RabbitMQ's let-one-oversized-through-when-empty size semantics).
+        no_ack consumers skip only the prefetch budgets — the buffer bound
+        still applies (they are exactly the consumers that can otherwise
+        buffer without limit)."""
         ch = self.channel
         if not ch.flow_active or ch.closed:
             return False
         if ch.connection.write_saturated:
             return False
+        limit = ch.connection.broker.flow_consumer_buffer
+        if limit and self.buffered_bytes + next_size > limit:
+            if self.buffered_bytes > 0:
+                if not self.slow:
+                    # one detection per episode; cleared when the
+                    # connection's output buffer drains to the kernel
+                    self.slow = True
+                    ch.connection.broker.metrics.flow_slow_consumers += 1
+                return False
         if self.no_ack:
             return True
         if ch.prefetch_count_consumer and self.unacked_count >= ch.prefetch_count_consumer:
@@ -207,6 +229,8 @@ class ServerChannel:
         self.connection.send_bytes(
             self._render_deliver(consumer, tag, qm.redelivered, msg, body))
         self.connection.delivered_msgs += 1
+        if self.connection.broker.flow_consumer_buffer:
+            consumer.buffered_bytes += len(body)
         metrics = self.connection.broker.metrics
         metrics.delivered(len(body))
         metrics.publish_to_deliver_us.observe_us(

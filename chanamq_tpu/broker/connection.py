@@ -46,6 +46,7 @@ from ..amqp.properties import BasicProperties
 from .. import trace
 from .broker import Broker, BrokerError
 from .channel import ChannelMode, Consumer, ServerChannel
+from ..flow import STAGE_THROTTLE
 
 log = logging.getLogger("chanamq.connection")
 
@@ -208,6 +209,15 @@ class AMQPConnection:
         self._held: dict[int, list] = {}
         self._held_bytes = 0
         self._park_full_since: Optional[float] = None
+        # flow-ladder per-connection state: publish credit remaining while
+        # the broker throttles (lazily granted from broker.flow_publish_credit
+        # at the first gated publish; None = no grant outstanding), the
+        # channels we sent Channel.Flow(active=false) to, and the
+        # perf-counter stamp of the first hold in the current park episode
+        # (feeds the flow-throttle trace span)
+        self._flow_credit: Optional[int] = None
+        self._flow_stopped: set[int] = set()
+        self._park_t0: Optional[int] = None
         # client announced capabilities.connection.blocked in start-ok:
         # it wants Connection.Blocked/Unblocked notifications
         self._supports_blocked = False
@@ -262,6 +272,11 @@ class AMQPConnection:
                     self.writer.write(data)
                     self._last_send = time.monotonic()
                     await self.writer.drain()
+                    if not self._out and self.broker.flow_consumer_buffer:
+                        # fully drained to the kernel: whatever this
+                        # connection's consumers had buffered is on the
+                        # wire — reset their delivery-buffer accounting
+                        self._reset_consumer_buffers()
                     if was_saturated and len(self._out) < WRITE_LOW_WATERMARK:
                         self._resume_dispatch()
                 if self.closing and not self._out:
@@ -276,6 +291,17 @@ class AMQPConnection:
             for consumer in channel.consumers.values():
                 consumer.queue.schedule_dispatch()
 
+    def _reset_consumer_buffers(self) -> None:
+        """Output buffer hit the kernel: clear per-consumer delivery-buffer
+        bytes and wake dispatch for any consumer that was marked slow."""
+        for channel in self.channels.values():
+            for consumer in channel.consumers.values():
+                if consumer.buffered_bytes:
+                    consumer.buffered_bytes = 0
+                    if consumer.slow:
+                        consumer.slow = False
+                        consumer.queue.schedule_dispatch()
+
     # ------------------------------------------------------------------
     # main loop
     # ------------------------------------------------------------------
@@ -285,6 +311,7 @@ class AMQPConnection:
         self.broker.metrics.connections_opened += 1
         self._writer_task = asyncio.create_task(self._writer_loop())
         self.broker.blocked_listeners.add(self._on_memory_blocked)
+        self.broker.flow_stage_listeners.add(self._on_flow_stage)
         self.broker.connections.add(self)
         try:
             await self._handshake()
@@ -297,6 +324,7 @@ class AMQPConnection:
             log.exception("connection %d crashed", self.id)
         finally:
             self.broker.blocked_listeners.discard(self._on_memory_blocked)
+            self.broker.flow_stage_listeners.discard(self._on_flow_stage)
             self.broker.connections.discard(self)
             await self._teardown()
 
@@ -310,6 +338,35 @@ class AMQPConnection:
                     reason=self.broker.blocked_reason))
             else:
                 self.send_method(0, am.Connection.Unblocked())
+
+    def _on_flow_stage(self, old: int, new: int) -> None:
+        """Flow-ladder transition (stage 2 = throttle): surface publisher
+        throttling on the wire as server-initiated Channel.Flow. Publishers
+        that honor it stop sending voluntarily; ones that don't hit the
+        park/credit path anyway (Flow is advisory, the hold is the law).
+        Consumer-only connections are left alone — pausing them would slow
+        the very drain that reopens the gate."""
+        if self.closing or not self._opened:
+            return
+        if new >= STAGE_THROTTLE and old < STAGE_THROTTLE:
+            if not self._has_published:
+                return
+            for channel_id, channel in self.channels.items():
+                if channel_id not in self._closing_channels:
+                    self.send_method(channel_id, am.Channel.Flow(active=False))
+                    self._flow_stopped.add(channel_id)
+            if self._flow_stopped:
+                self.broker.metrics.flow_throttles += 1
+        elif new < STAGE_THROTTLE and old >= STAGE_THROTTLE:
+            resumed = False
+            for channel_id in self._flow_stopped:
+                if (channel_id in self.channels
+                        and channel_id not in self._closing_channels):
+                    self.send_method(channel_id, am.Channel.Flow(active=True))
+                    resumed = True
+            self._flow_stopped.clear()
+            if resumed:
+                self.broker.metrics.flow_resumes += 1
 
     def notify_consumer_cancel(self, channel: ServerChannel, tag: str) -> None:
         """Server-sent Basic.Cancel: the queue died under this consumer
@@ -354,6 +411,9 @@ class AMQPConnection:
             self._has_published = True  # set at hold time too: a fully-held
             # publisher must still read as a publisher everywhere the flag
             # is consulted
+        if self._park_t0 is None:
+            # first hold of this park episode: start the flow-throttle span
+            self._park_t0 = time.perf_counter_ns()
         self._held.setdefault(command.channel, []).append(command)
         # cost = body + flat per-command overhead, so a flood of empty-body
         # publishes (legal AMQP) still trips the cap instead of accumulating
@@ -364,8 +424,10 @@ class AMQPConnection:
         # gating their own release would deadlock the gate (they only
         # leave RAM by being released below the low watermark). They
         # are structurally bounded instead: PARK_BUF_MAX per
-        # connection x the listener's max-connections cap.
-        self.broker.held_bytes += cost
+        # connection x the listener's max-connections cap. The flow
+        # accountant counts them in the reported total but excludes them
+        # from ladder decisions for the same deadlock reason.
+        self.broker.account_held(cost)
 
     @classmethod
     def _held_cost(cls, command: AMQCommand) -> int:
@@ -380,10 +442,11 @@ class AMQPConnection:
         hard bound: a flooder parking one unacked delivery as a hostage
         buys 4x PARK_BUF_MAX, not an unbounded hold, and the ack-timeout
         sweep eventually closes channels that never ack."""
+        base = self.broker.park_buf_max or self.PARK_BUF_MAX
         for channel in self.channels.values():
             if channel.unacked:
-                return 4 * self.PARK_BUF_MAX
-        return self.PARK_BUF_MAX
+                return 4 * base
+        return base
 
     def _should_hold(self, command: AMQCommand) -> bool:
         method_type = type(command.method)
@@ -396,9 +459,32 @@ class AMQPConnection:
             return False
         if command.channel in self._held:
             return True  # per-channel FIFO behind an already-held publish
-        return (self.broker.blocked
+        if (self.broker.blocked
                 and method_type is am.Basic.Publish
-                and command.channel != 0)
+                and command.channel != 0):
+            # per-connection publish credit (chana.mq.flow.publish-credit):
+            # the first gated publishes spend a bounded byte allowance
+            # before the hard hold engages, so a well-behaved publisher
+            # that reacts to Channel.Flow(active=false) in time never
+            # parks at all. Credit 0 (the Broker default) holds
+            # immediately — the legacy gate contract.
+            return not self._spend_flow_credit(command)
+        return False
+
+    def _spend_flow_credit(self, command: AMQCommand) -> bool:
+        """Spend publish credit for one gated publish; True while credit
+        remains (the publish executes instead of holding). The grant is
+        lazy — taken from the broker knob at the first gated publish of a
+        throttle episode — and reset when the gate reopens."""
+        grant = self.broker.flow_publish_credit
+        if not grant:
+            return False
+        if self._flow_credit is None:
+            self._flow_credit = grant
+        if self._flow_credit <= 0:
+            return False
+        self._flow_credit -= self._held_cost(command)
+        return True
 
     async def _release_held(self) -> bool:
         """Gate reopened: execute held commands, per-channel FIFO (channel
@@ -408,19 +494,29 @@ class AMQPConnection:
         held, self._held = self._held, {}
         self._held_bytes = 0
         self._park_full_since = None
+        self._flow_credit = None  # fresh grant next throttle episode
+        if self._park_t0 is not None:
+            t0, self._park_t0 = self._park_t0, None
+            t1 = time.perf_counter_ns()
+            self.broker.metrics.flow_hold_releases += 1
+            self.broker.metrics.flow_hold_wait_ns += t1 - t0
+            if trace.ACTIVE is not None:
+                # the first released publish carries the flow-throttle span
+                # (how long the gate parked this connection's stream)
+                trace.ACTIVE.flow_ns = (t0, t1)
         queues = list(held.values())
         for qi, commands in enumerate(queues):
             for ci, command in enumerate(commands):
-                self.broker.held_bytes -= self._held_cost(command)
+                self.broker.account_held(-self._held_cost(command))
                 if not await self._run_command(command):
                     # connection is stopping: release the gauge for every
                     # command not yet un-accounted (none were confirmed —
                     # seqs are assigned at execution time)
                     for rest in commands[ci + 1:]:
-                        self.broker.held_bytes -= self._held_cost(rest)
+                        self.broker.account_held(-self._held_cost(rest))
                     for later in queues[qi + 1:]:
                         for rest in later:
-                            self.broker.held_bytes -= self._held_cost(rest)
+                            self.broker.account_held(-self._held_cost(rest))
                     return False
         # same barrier as the main loop: confirms for persistent publishes
         # must not ack until their store writes are flushed (a barrier
@@ -505,6 +601,21 @@ class AMQPConnection:
     async def _run_command(self, out: AMQCommand) -> bool:
         """Dispatch one assembled command with the connection's error
         semantics. Returns False when the connection must stop serving."""
+        if (self.broker.flow_refusing
+                and type(out.method) is am.Basic.Publish
+                and out.channel != 0
+                and out.channel not in self._held):
+            # ladder stage 4 (refuse): past the refuse watermark, fresh
+            # publishes are rejected outright with a channel-level
+            # precondition error instead of parked — holding more bodies
+            # would push accounted memory toward the hard limit while
+            # consumers drain. Publishes already FIFO-queued behind a held
+            # one still park (closing their channel would orphan them).
+            self.broker.metrics.flow_publishes_refused += 1
+            await self._soft_close_channel(out.channel, ChannelError(
+                ErrorCode.PRECONDITION_FAILED,
+                "memory overload: broker refusing publishes"))
+            return not self.closing
         if (self._held or self.broker.blocked) and self._should_hold(out):
             self._hold_command(out)
             return True
@@ -936,9 +1047,10 @@ class AMQPConnection:
         if self._held:
             for commands in self._held.values():
                 for command in commands:
-                    self.broker.held_bytes -= self._held_cost(command)
+                    self.broker.account_held(-self._held_cost(command))
             self._held.clear()
             self._held_bytes = 0
+            self._park_t0 = None
         # buffered/chained pipelined remote pushes: send them (the broker
         # accepted these publishes pre-teardown; dropping them would lose
         # messages) and log any failures best-effort

@@ -25,6 +25,7 @@ No polling, no idle CPU burn, and delivery latency is one loop hop.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 import time
 from collections import deque
@@ -358,6 +359,12 @@ class Queue:
         max_resident = (self.max_resident_override
                         if self.max_resident_override is not None
                         else self.broker.queue_max_resident)
+        # flow stage >= 1 tightens the cap to the pressure watermark, but
+        # only where passivation is enabled at all: a 0 cap is an explicit
+        # operator opt-out that memory pressure must not override
+        page_cap = self.broker.flow_page_resident_active
+        if max_resident and page_cap and page_cap < max_resident:
+            max_resident = page_cap
         if (max_resident and len(self.messages) > max_resident
                 and message.body is not None):
             if not (message.persisted or message.paged):
@@ -378,8 +385,55 @@ class Queue:
             # hydrated delivery needs just the blob read
             message.body = None
             self._passivated.append(qm)
+            if page_cap:
+                self.broker.metrics.flow_paged_bodies += 1
+                self.broker.metrics.flow_paged_bytes += qm.body_size
         self.schedule_dispatch()
         return qm
+
+    def passivate_excess(self, cap: int) -> int:
+        """Stage-1 pressure actuation (Broker._sweep_loop): page every
+        resident body past the pressure cap out to the store, oldest part
+        of the tail first — the head stays resident so dispatch serves it
+        without a hydration round-trip. Same per-entry mechanics as the
+        push-path passivation above; respects a queue whose passivation
+        is explicitly disabled (cap 0)."""
+        if self.is_stream or cap <= 0:
+            return 0
+        base = (self.max_resident_override
+                if self.max_resident_override is not None
+                else self.broker.queue_max_resident)
+        if not base:
+            return 0
+        cap = min(cap, base)
+        if len(self.messages) <= cap:
+            return 0
+        broker = self.broker
+        paged = 0
+        for qm in itertools.islice(self.messages, cap, None):
+            message = qm.message
+            if message.body is None:
+                continue
+            if not (message.persisted or message.paged):
+                message.paged = True
+                broker.store.insert_message_nowait(
+                    StoredMessage(
+                        id=message.id,
+                        properties_raw=message.header_payload(),
+                        body=message.body, exchange=message.exchange,
+                        routing_key=message.routing_key,
+                        refer_count=message.refer_count,
+                        ttl_ms=message.ttl_ms,
+                    ))
+            if message.accounted:
+                broker.account_memory(-len(message.body))
+                message.accounted = False
+            message.body = None
+            self._passivated.append(qm)
+            paged += 1
+            broker.metrics.flow_paged_bodies += 1
+            broker.metrics.flow_paged_bytes += qm.body_size
+        return paged
 
     def _requeue_priority(self, qm: QueuedMessage) -> None:
         """Requeue into (priority desc, offset asc) position. Durable

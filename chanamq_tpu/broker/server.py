@@ -253,6 +253,22 @@ class BrokerServer:
                 "chana.mq.stream.cache-segments"),
             stream_delivery_batch=config.int(
                 "chana.mq.stream.delivery-batch") or 128,
+            # flow-control ladder (chana.mq.flow.*): thresholds default
+            # off the memory watermarks; None keeps the derived defaults
+            flow_page_watermark=config.size_bytes(
+                "chana.mq.flow.page-watermark"),
+            flow_cluster_watermark=config.size_bytes(
+                "chana.mq.flow.cluster-watermark"),
+            flow_refuse_watermark=config.size_bytes(
+                "chana.mq.flow.refuse-watermark"),
+            flow_hard_limit=config.size_bytes("chana.mq.flow.hard-limit"),
+            flow_publish_credit=config.size_bytes(
+                "chana.mq.flow.publish-credit") or 0,
+            flow_consumer_buffer=config.size_bytes(
+                "chana.mq.flow.consumer-buffer") or 0,
+            park_buffer=config.size_bytes("chana.mq.flow.park-buffer"),
+            flow_page_resident=config.int("chana.mq.flow.page-resident")
+            or 0,
         )
         if store is not None and hasattr(store, "metrics"):
             # the WAL engine's wal_* counters must land in the broker
@@ -473,6 +489,8 @@ async def run_node(config) -> None:
                     repl_lag=float(config.int("chana.mq.alerts.repl-lag")),
                     loop_lag_ms=float(
                         config.int("chana.mq.alerts.loop-lag-ms")),
+                    memory_stage=float(
+                        config.get("chana.mq.alerts.memory-stage") or 3.5),
                 ),
                 alerts_enabled=config.bool("chana.mq.alerts.enabled"),
                 loop_lag_ready_ms=float(

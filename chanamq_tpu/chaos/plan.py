@@ -33,6 +33,9 @@ Fault kinds and what the seams do with them:
 ``crash``       invoke the harness-registered crash handler for ``nodes``
 ``partition``   like ``error`` but only when the ctx peer is in ``nodes``
                 (A<->B partition = traffic toward the named nodes fails)
+``pressure``    inflate the flow accountant's ``chaos`` component by
+                ``inflate_bytes`` for this sweep tick (site ``flow.tick``)
+                so memory-overload behavior is injectable deterministically
 """
 
 from __future__ import annotations
@@ -47,6 +50,7 @@ from typing import Any, Optional
 
 FAULT_KINDS = (
     "latency", "error", "drop", "disconnect", "corrupt", "crash", "partition",
+    "pressure",
 )
 
 # fire-log ring bound: enough to replay a soak, small enough to forget
@@ -62,6 +66,7 @@ class Fault:
     delay_s: float = 0.0
     code: str = "chaos"
     message: str = ""
+    inflate_bytes: int = 0
 
 
 @dataclass
@@ -80,6 +85,7 @@ class FaultRule:
     code: str = "chaos"
     message: str = ""
     nodes: list[str] = field(default_factory=list)  # crash / partition targets
+    inflate_bytes: int = 0              # pressure: accounted-cost inflation
 
     def __post_init__(self) -> None:
         if self.kind not in FAULT_KINDS:
@@ -106,6 +112,7 @@ class FaultRule:
             "after": self.after, "until": self.until, "peer": self.peer,
             "delay_ms": self.delay_ms, "code": self.code,
             "message": self.message, "nodes": list(self.nodes),
+            "inflate_bytes": self.inflate_bytes,
         }
 
     @classmethod
@@ -113,6 +120,7 @@ class FaultRule:
         known = {
             "name", "kind", "sites", "probability", "count", "after",
             "until", "peer", "delay_ms", "code", "message", "nodes",
+            "inflate_bytes",
         }
         return cls(**{k: v for k, v in data.items() if k in known})
 
@@ -171,7 +179,8 @@ class FaultPlan:
             return Fault(
                 kind=rule.kind, rule=rule.name,
                 delay_s=rule.delay_ms / 1000.0, code=rule.code,
-                message=rule.message or f"injected by rule {rule.name!r}")
+                message=rule.message or f"injected by rule {rule.name!r}",
+                inflate_bytes=rule.inflate_bytes)
         return None
 
     @staticmethod

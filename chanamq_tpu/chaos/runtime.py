@@ -35,6 +35,7 @@ _KIND_COUNTERS = {
     "corrupt": "chaos_corrupt_frames",
     "crash": "chaos_crashes",
     "partition": "chaos_partition_drops",
+    "pressure": "chaos_pressure",
 }
 
 

@@ -437,6 +437,450 @@ async def run_soak(
 # the scripted alert phase must fire exactly these rules, every run
 EXPECTED_ALERT_RULES = ("backlog-growth", "consumer-stall")
 
+# the overload soak's scripted pressure phase must fire exactly this rule
+OVERLOAD_ALERT_RULES = ("memory-pressure",)
+
+
+def overload_plan(seed: int, *, pre_ticks: int = 20,
+                  pressure_ticks: int = 80,
+                  inflate_bytes: int = 3_900_000) -> FaultPlan:
+    """The overload soak's fault plan: one ``pressure`` rule riding the
+    broker's ``flow.tick`` sweep site. The window is invocation-indexed
+    (sweep tick N), so for a given plan the accountant sees the same
+    inflation series every run: zero for ``pre_ticks`` ticks, then
+    ``inflate_bytes`` for ``pressure_ticks`` ticks, then zero again.
+    The default inflation sits between the refuse watermark and the hard
+    limit of the soak's broker, so the ladder jumps straight to the
+    refuse stage and the headroom left for real accounted bytes is what
+    the peak-under-hard-limit invariant exercises."""
+    return FaultPlan(seed, [
+        FaultRule(name="memory-pressure", kind="pressure",
+                  sites=["flow.tick"], after=pre_ticks,
+                  until=pre_ticks + pressure_ticks,
+                  inflate_bytes=inflate_bytes),
+    ])
+
+
+async def run_overload_soak(
+    seed: int, *, messages: int = 160, body_bytes: int = 1024,
+    plan: Optional[FaultPlan] = None,
+) -> dict:
+    """Single-node overload soak: a deterministic memory-pressure chaos
+    rule drives the flow ladder to the refuse stage while a flooding
+    publisher hammers the broker at far beyond the consumer's drain rate.
+    Returns a report whose ``violations`` list is empty iff:
+
+    1. **Accounted bytes never exceed the hard limit** — the ladder's
+       whole point: paging + throttling + refusal keep the accountant's
+       peak (chaos inflation included) under ``flow.hard-limit``.
+    2. **Zero confirmed-message loss** — every body whose publisher
+       confirm arrived is delivered, refusals and channel closes
+       notwithstanding (a refused publish is never confirmed).
+    3. **Publishes are actually refused at the refuse stage** (406
+       PRECONDITION_FAILED channel close) while the attached consumer
+       keeps draining the backlog.
+    4. **channel.flow stop/resume round-trips on the wire** — the
+       well-behaved publisher sees exactly Flow(active=False) on
+       escalation and Flow(active=True) on recovery, and publishes its
+       remaining quota after the resume.
+    5. **Full recovery to the low watermark** — once the pressure window
+       closes, the ladder cascades back to stage 0 and the accounted
+       total settles at/below the low watermark.
+    6. **Deterministic alerting and readiness** — the harness-ticked
+       telemetry fires exactly ``memory-pressure`` (and resolves it),
+       and /admin/health readiness drops only during the refuse stage.
+    """
+    import time
+
+    from ..broker.broker import Broker
+    from ..broker.server import BrokerServer
+    from ..client.client import AMQPClient
+    from ..flow import STAGE_REFUSE, STAGE_THROTTLE
+    from ..store.memory import MemoryStore
+    from ..telemetry import TelemetryService
+    from ..telemetry.alerts import default_rules as alert_defaults
+
+    broker = Broker(
+        store=MemoryStore(),
+        message_sweep_interval_s=0.05,    # fast flow ticks for the soak
+        queue_max_resident=8,             # base passivation stays on
+        flow_high_watermark=128 * 1024,
+        flow_hard_limit=4 * 1024 * 1024,  # refuse = 90% of this
+        flow_page_resident=2,             # stage>=1 pages queues to 2 bodies
+        flow_publish_credit=16 * 1024,
+        flow_consumer_buffer=4 * 1024 * 1024,
+    )
+    flow = broker.flow
+    if plan is None:
+        plan = overload_plan(seed)
+    srv = BrokerServer(broker=broker, host="127.0.0.1", port=0,
+                       heartbeat_s=0)
+    # harness-ticked telemetry: every rule except memory-pressure gets an
+    # unreachable threshold so the firing set is a pure function of the
+    # scripted pressure window
+    broker.telemetry = TelemetryService(
+        broker, interval_s=1.0, ring_ticks=64,
+        rules=alert_defaults(backlog_growth=1e12, stall_ticks=10**6,
+                             repl_lag=1e12, loop_lag_ms=1e12,
+                             memory_stage=3.5))
+    svc = broker.telemetry
+
+    # throttle episode wall-clock, observed at the broker's own ladder
+    throttle_t: dict[str, float] = {}
+
+    def stage_watch(old: int, new: int) -> None:
+        if new >= STAGE_THROTTLE and old < STAGE_THROTTLE:
+            throttle_t.setdefault("start", time.perf_counter())
+        if new < STAGE_THROTTLE <= old:
+            throttle_t["end"] = time.perf_counter()
+
+    flow.listeners.append(stage_watch)
+
+    violations: list[str] = []
+    conns: list = []
+    qn = "overload_q"
+    pad = b"x" * body_bytes
+    phase_a = min(64, max(8, messages // 3))
+    phase_resume = min(32, max(4, messages // 5))
+    p2_count = max(1, messages - phase_a - phase_resume)
+
+    async def wait_for(predicate, timeout: float, what: str) -> bool:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while not predicate():
+            if asyncio.get_event_loop().time() > deadline:
+                violations.append(f"timeout waiting for {what}")
+                return False
+            await asyncio.sleep(0.01)
+        return True
+
+    try:
+        await srv.start()
+        runtime = install(plan, metrics=broker.metrics)
+        fingerprint = plan.fingerprint()
+
+        deliveries: dict[bytes, int] = {}
+
+        # -- well-behaved publisher P1: floods a backlog before the
+        #    pressure window, then honors channel.flow
+        p1 = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        conns.append(p1)
+        p1_ch = await p1.channel()
+        await p1_ch.confirm_select()
+        await p1_ch.queue_declare(qn)
+        for i in range(phase_a):
+            p1_ch.basic_publish(b"p1-%05d" % i + pad, routing_key=qn)
+        await p1_ch.wait_unconfirmed_below(1, timeout=15)
+        confirmed: set[bytes] = {b"p1-%05d" % i for i in range(phase_a)}
+
+        # -- the pressure window opens: the ladder must jump to refuse
+        await wait_for(lambda: flow.stage >= STAGE_REFUSE, 15,
+                       "refuse stage under chaos pressure")
+        stage4_total = flow.total
+
+        # readiness drops only now, with the stage as the reason
+        svc.sample_tick(1.0)
+        svc.sample_tick(1.0)
+        health_mid = svc.health()
+        if health_mid["ready"]:
+            violations.append("health stayed ready at the refuse stage")
+        if not any("memory pressure" in r for r in health_mid["reasons"]):
+            violations.append(
+                f"refuse-stage health reasons lack memory pressure: "
+                f"{health_mid['reasons']}")
+
+        # -- flooding publisher P2: 10x+ the drain rate by construction
+        #    (saturated in-process bursts, no pacing). Refusals close its
+        #    channel with 406; it reopens and retries until everything it
+        #    ever got confirmed is accounted, nothing more.
+        refusals_seen = 0
+
+        async def p2_run() -> set[bytes]:
+            nonlocal refusals_seen
+            conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+            conns.append(conn)
+            ch = None
+            sent: dict[int, int] = {}    # publish seq -> message index
+            todo = list(range(p2_count))
+            done: set[bytes] = set()
+            deadline = asyncio.get_event_loop().time() + 60
+            while todo or sent:
+                if asyncio.get_event_loop().time() > deadline:
+                    violations.append(
+                        f"P2 never finished: {len(todo)} todo, "
+                        f"{len(sent)} unresolved")
+                    break
+                if ch is None or ch.closed:
+                    if ch is not None:
+                        # a 406 refusal closed the channel: seqs no longer
+                        # in `unconfirmed` were acked before the close and
+                        # stay confirmed; the rest were never executed
+                        refusals_seen += 1
+                        pending = set(ch.unconfirmed)
+                        for seq, idx in sent.items():
+                            if seq in pending:
+                                todo.append(idx)
+                            else:
+                                done.add(b"p2-%05d" % idx)
+                        sent = {}
+                        await asyncio.sleep(0.05)
+                    ch = await conn.channel()
+                    await ch.confirm_select()
+                while todo and len(ch.unconfirmed) < 32:
+                    idx = todo.pop()
+                    seq = ch.basic_publish(b"p2-%05d" % idx + pad,
+                                           routing_key=qn)
+                    sent[seq] = idx
+                try:
+                    await ch.wait_unconfirmed_below(1, timeout=5)
+                except Exception:
+                    continue  # closed (refused) or still gated: resolve above
+                done.update(b"p2-%05d" % idx for idx in sent.values())
+                sent = {}
+            return done
+
+        p2_task = asyncio.create_task(p2_run())
+        await wait_for(lambda: broker.metrics.flow_publishes_refused > 0,
+                       10, "a refused publish at the refuse stage")
+
+        # stage >= 1 tightened the resident cap: before the consumer can
+        # drain the backlog away, the sweep must page bodies beyond
+        # flow.page-resident out to the store
+        await wait_for(lambda: broker.metrics.flow_paged_bodies > 0, 10,
+                       "flow-paged bodies under pressure")
+
+        # -- consumer attaches mid-refusal: draining must keep working
+        #    while publishers are being refused
+        c_conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        conns.append(c_conn)
+        c_ch = await c_conn.channel()
+        await c_ch.basic_qos(prefetch_count=64)
+
+        def on_msg(msg):
+            body = bytes(msg.body[:8])
+            deliveries[body] = deliveries.get(body, 0) + 1
+            c_ch.basic_ack(msg.delivery_tag)
+
+        await c_ch.basic_consume(qn, on_msg, consumer_tag="overload")
+        await wait_for(
+            lambda: sum(deliveries.values()) >= phase_a // 2, 15,
+            "consumer drain progress during the refuse stage")
+        drained_under_refuse = (flow.stage >= STAGE_REFUSE,
+                                sum(deliveries.values()))
+        if not drained_under_refuse[0]:
+            violations.append(
+                "pressure window ended before the drain-under-refuse "
+                "observation (window too short for this host)")
+
+        # -- the window closes: full recovery, publisher resume included
+        await wait_for(lambda: flow.stage == 0, 30,
+                       "recovery to stage 0 after the pressure window")
+        confirmed |= await asyncio.wait_for(p2_task, 60)
+        for _ in range(4):
+            svc.sample_tick(1.0)
+        health_end = svc.health()
+        if not health_end["ready"]:
+            violations.append(
+                f"health not ready after recovery: {health_end['reasons']}")
+
+        # the well-behaved publisher saw exactly stop -> resume and can
+        # publish its remaining quota afterwards
+        await wait_for(lambda: p1_ch.flow_events == [False, True], 10,
+                       "channel.flow stop/resume pair on the idle publisher")
+        if p1_ch.flow_events != [False, True]:
+            violations.append(
+                f"publisher flow events not [stop, resume]: "
+                f"{p1_ch.flow_events}")
+        for i in range(phase_resume):
+            p1_ch.basic_publish(b"p1-%05d" % (phase_a + i) + pad,
+                                routing_key=qn)
+        await p1_ch.wait_unconfirmed_below(1, timeout=15)
+        confirmed |= {b"p1-%05d" % (phase_a + i) for i in range(phase_resume)}
+
+        # -- zero confirmed loss: every confirmed body delivered
+        await wait_for(lambda: confirmed <= set(deliveries), 30,
+                       "every confirmed message delivered")
+        missing = sorted(confirmed - set(deliveries))
+        if missing:
+            violations.append(
+                f"confirmed-but-lost: {len(missing)} messages "
+                f"(first: {[m.decode() for m in missing[:5]]})")
+        duplicates = sum(n - 1 for n in deliveries.values() if n > 1)
+
+        # -- the hard invariants on the accountant itself
+        if flow.peak_total > flow.hard_limit:
+            violations.append(
+                f"accounted peak {flow.peak_total} exceeded the hard "
+                f"limit {flow.hard_limit}")
+        await wait_for(lambda: flow.total <= flow.low_watermark, 10,
+                       "accounted total back at/below the low watermark")
+        if broker.metrics.flow_publishes_refused == 0:
+            violations.append("no publish was ever refused")
+        if refusals_seen == 0:
+            violations.append("the flooder never observed a 406 refusal")
+
+        # -- exact alert firings: memory-pressure and nothing else
+        snapshot = svc.engine.snapshot()
+        fired = tuple(snapshot["fired_rules"])
+        if fired != OVERLOAD_ALERT_RULES:
+            violations.append(
+                f"alert firings not exact: expected {OVERLOAD_ALERT_RULES}, "
+                f"got {fired}")
+        if snapshot["firing"]:
+            violations.append(
+                f"alerts still firing after recovery: "
+                f"{[i['rule'] for i in snapshot['firing']]}")
+
+        m = broker.metrics
+        return {
+            "seed": seed,
+            "fingerprint": fingerprint,
+            "messages": messages,
+            "confirmed": len(confirmed),
+            "delivered_unique": len(set(deliveries) & confirmed),
+            "duplicates": duplicates,
+            "drained_under_refuse": drained_under_refuse[1],
+            "peak_accounted_bytes": flow.peak_total,
+            "hard_limit": flow.hard_limit,
+            "under_hard_limit": flow.peak_total <= flow.hard_limit,
+            "refuse_stage_total_bytes": stage4_total,
+            "final_stage": flow.stage,
+            "final_total_bytes": flow.total,
+            "low_watermark": flow.low_watermark,
+            "publishes_refused": m.flow_publishes_refused,
+            "refusal_channel_closes": refusals_seen,
+            "paged_bodies": m.flow_paged_bodies,
+            "paged_bytes": m.flow_paged_bytes,
+            "flow_throttles": m.flow_throttles,
+            "flow_resumes": m.flow_resumes,
+            "escalations": m.flow_escalations,
+            "deescalations": m.flow_deescalations,
+            "chaos_pressure_ticks": m.chaos_pressure,
+            "throttle_latency_s": round(
+                throttle_t.get("end", 0.0) - throttle_t["start"], 3)
+                if "start" in throttle_t and "end" in throttle_t else None,
+            "hold_wait_ms": round(m.flow_hold_wait_ns / 1e6, 3),
+            "hold_releases": m.flow_hold_releases,
+            "health_mid": {"ready": health_mid["ready"],
+                           "stage": health_mid["checks"]
+                           ["memory_pressure"]["stage_label"]},
+            "health_end": {"ready": health_end["ready"]},
+            "alerts": {"fired_rules": list(fired),
+                       "fired_total": snapshot["fired_total"],
+                       "resolved_total": snapshot["resolved_total"]},
+            "chaos": runtime.status(),
+            "violations": violations,
+        }
+    finally:
+        clear()
+        for conn in conns:
+            try:
+                await conn.close()
+            except Exception:
+                pass
+        try:
+            await srv.stop()
+        except Exception:
+            pass
+
+
+async def run_connection_churn(cycles: int = 500, *,
+                               bodies_per_cycle: int = 3,
+                               body_bytes: int = 2048) -> dict:
+    """Connection-churn leak check: `cycles` connect / declare-exclusive /
+    publish-confirmed / disconnect rounds (every other one an abrupt
+    socket abort instead of a clean Connection.Close), then assert the
+    memory accountant is back to zero — the exclusive queues die with
+    their connections, so any surviving accounted byte is a leak in the
+    hold/release or queue-teardown accounting."""
+    from ..broker.broker import Broker
+    from ..broker.server import BrokerServer
+    from ..client.client import AMQPClient
+    from ..store.memory import MemoryStore
+
+    broker = Broker(store=MemoryStore(), queue_max_resident=64,
+                    message_sweep_interval_s=0.05,
+                    flow_high_watermark=64 * 1024)
+    flow = broker.flow
+    srv = BrokerServer(broker=broker, host="127.0.0.1", port=0,
+                       heartbeat_s=0)
+    violations: list[str] = []
+    body = b"c" * body_bytes
+    aborted = 0
+    try:
+        await srv.start()
+        for i in range(cycles):
+            conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+            try:
+                ch = await conn.channel()
+                await ch.confirm_select()
+                qn = f"churn_{i}"
+                await ch.queue_declare(qn, exclusive=True)
+                for _ in range(bodies_per_cycle):
+                    ch.basic_publish(body, routing_key=qn)
+                await ch.wait_unconfirmed_below(1, timeout=10)
+                if i % 2:
+                    # abrupt death: no Connection.Close — teardown
+                    # accounting must still release everything
+                    try:
+                        conn.reader._transport.abort()
+                        aborted += 1
+                    except Exception:
+                        await conn.close()
+                else:
+                    await conn.close()
+            except Exception as exc:
+                violations.append(f"cycle {i}: {type(exc).__name__}: {exc}")
+                try:
+                    await conn.close()
+                except Exception:
+                    pass
+                break
+
+        deadline = asyncio.get_event_loop().time() + 15
+        while broker.connections and \
+                asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.02)
+        if broker.connections:
+            violations.append(
+                f"{len(broker.connections)} connection(s) never torn down")
+        # a couple of sweep ticks so the polled components resample
+        await asyncio.sleep(0.15)
+
+        leaked = broker.resident_bytes + broker.held_bytes
+        if leaked:
+            violations.append(
+                f"accounted-bytes leak after churn: resident="
+                f"{broker.resident_bytes} held={broker.held_bytes}")
+        live_queues = sum(len(v.queues) for v in broker.vhosts.values())
+        if live_queues:
+            violations.append(
+                f"{live_queues} exclusive queue(s) survived their "
+                f"connections")
+        gate_components = {
+            k: v for k, v in flow.components.items()
+            if k in ("bodies", "held") and v}
+        if gate_components:
+            violations.append(
+                f"flow accountant still charged after churn: "
+                f"{gate_components}")
+        return {
+            "cycles": cycles,
+            "aborted": aborted,
+            "bodies_per_cycle": bodies_per_cycle,
+            "body_bytes": body_bytes,
+            "leaked_bytes": leaked,
+            "final_total_bytes": flow.total,
+            "peak_accounted_bytes": flow.peak_total,
+            "final_stage": flow.stage,
+            "live_queues": sum(len(v.queues) for v in broker.vhosts.values()),
+            "violations": violations,
+        }
+    finally:
+        try:
+            await srv.stop()
+        except Exception:
+            pass
+
 
 async def _alert_phase(srv, cl, violations: list[str]) -> dict:
     """Invariant 6b: drive the surviving node's telemetry through a

@@ -565,6 +565,11 @@ class ClientChannel:
         self.returns: list[ReturnedMessage] = []
         # consumer tags the SERVER cancelled (queue died under them)
         self.cancelled_consumers: list[str] = []
+        # server-initiated Channel.Flow state (broker overload throttle):
+        # False while the broker asked us to stop publishing; flow_events
+        # records every transition in order for tests/diagnostics
+        self.flow_active = True
+        self.flow_events: list[bool] = []
         # confirm mode
         self.confirm_mode = False
         self._publish_seq = 0
@@ -652,6 +657,8 @@ class ClientChannel:
                 ChannelClosedError(method.reply_code, method.reply_text))
             return
         if isinstance(method, am.Channel.Flow):
+            self.flow_active = method.active
+            self.flow_events.append(method.active)
             self.client._send_method(self.id, am.Channel.FlowOk(active=method.active))
             return
         if isinstance(method, (am.Basic.GetOk, am.Basic.GetEmpty)):

@@ -513,6 +513,20 @@ class PeerDataPlane:
         self._settle_inflight: set[asyncio.Future] = set()
         self._timer: Optional[asyncio.TimerHandle] = None
         self.closed = False
+        # pressure mode (flow ladder stage 3, set by ClusterNode): shrink
+        # the effective flush caps so batches toward this peer stay small
+        # — less buffered per hop, and the per-stream in-flight windows
+        # throttle submitters sooner
+        self.pressure = False
+
+    def buffered_bytes(self) -> int:
+        """Bytes sitting in the unflushed push accumulators toward this
+        peer (the flow accountant's per-peer data-plane share)."""
+        total = 0
+        for acc in self._push:
+            if acc is not None:
+                total += acc[2]
+        return total
 
     # -- stream striping ---------------------------------------------------
 
@@ -558,9 +572,13 @@ class PeerDataPlane:
             if self.intra_node:
                 self.metrics.shard_cross_pushes += 1
         fut = acc[3]
-        if acc[1] >= self.flush_max_count or acc[2] >= self.flush_max_bytes:
+        max_count, max_bytes = self.flush_max_count, self.flush_max_bytes
+        if self.pressure:
+            max_count = max(1, max_count // 8)
+            max_bytes = max(1, max_bytes // 8)
+        if acc[1] >= max_count or acc[2] >= max_bytes:
             if self.metrics is not None:
-                if acc[1] >= self.flush_max_count:
+                if acc[1] >= max_count:
                     self.metrics.rpc_flush_count += 1
                 else:
                     self.metrics.rpc_flush_bytes += 1

@@ -34,6 +34,7 @@ from typing import TYPE_CHECKING, Any, Optional
 
 from .. import trace
 from ..amqp.properties import BasicProperties
+from ..flow import STAGE_CLUSTER
 from ..replicate import ReplicationManager
 from . import dataplane as dp
 from .dataplane import PeerDataPlane
@@ -142,6 +143,9 @@ class ClusterNode:
         self._anti_entropy_task: Optional[asyncio.Task] = None
         self.name: str = ""
         broker.cluster = self
+        # flow-ladder stage 3 (cluster): shrink peer flush windows so
+        # pushback propagates across shard/cluster hops (see dataplane())
+        broker.flow_stage_listeners.add(self._on_flow_stage)
         self._register_handlers()
         # queue replication (chana.mq.replicate.*): factor 1 = off; the
         # manager registers its own repl.* RPC handlers
@@ -207,6 +211,7 @@ class ClusterNode:
             except (asyncio.CancelledError, Exception):
                 pass
             self._anti_entropy_task = None
+        self.broker.flow_stage_listeners.discard(self._on_flow_stage)
         dataplanes, self._dataplanes = self._dataplanes, {}
         for plane in dataplanes.values():
             await plane.close()
@@ -513,8 +518,28 @@ class ClusterNode:
                 flush_max_count=self._dp_flush_max_count,
                 metrics=self.broker.metrics,
                 node_tag=self.name)
+            flow = self.broker.flow
+            plane.pressure = (flow is not None
+                              and flow.stage >= STAGE_CLUSTER)
             self._dataplanes[(node, kind)] = plane
         return plane
+
+    def _on_flow_stage(self, old: int, new: int) -> None:
+        """Broker flow-ladder transition: at/above the cluster stage every
+        peer data plane switches to pressure mode (flush caps shrink, so
+        this node buffers less toward peers and the per-stream in-flight
+        windows throttle the origin side sooner)."""
+        pressured = new >= STAGE_CLUSTER
+        for plane in self._dataplanes.values():
+            plane.pressure = pressured
+
+    def dataplane_buffered_bytes(self) -> int:
+        """Bytes accumulated toward peers but not yet flushed — the flow
+        accountant's ``cluster_inflight`` component, polled per sweep."""
+        total = 0
+        for plane in self._dataplanes.values():
+            total += plane.buffered_bytes()
+        return total
 
     async def _event(self, node: str, method: str, payload: dict) -> None:
         """Fire-and-forget event toward a peer. Loss is part of the design
@@ -902,6 +927,7 @@ class ClusterNode:
         RPC == publish order; the origin serializes batches at its confirm
         barrier). One store flush covers every persistent push, so the
         owner group-commits the batch exactly like local publishes."""
+        await self._flow_stall()
         marks: list[tuple[int, int]] = []
         any_persisted = False
         for push in payload.get("pushes") or []:
@@ -923,6 +949,18 @@ class ClusterNode:
                 await self.replication.sync_barrier()
         return {"ok": True}
 
+    async def _flow_stall(self) -> None:
+        """Owner-side pushback (flow ladder stage 3): a pressured owner
+        delays accepting a push batch for one bounded wait, which holds the
+        batch's reply, fills the origin's per-stream in-flight window, and
+        ultimately slows the origin's publishers — the cross-hop analogue
+        of parking a local publisher. Bounded, never a refusal: at worst a
+        batch lands one stall late."""
+        flow = self.broker.flow
+        if flow is not None and flow.stage >= STAGE_CLUSTER:
+            self.broker.metrics.flow_cluster_stalls += 1
+            await flow.cluster_stall()
+
     # ------------------------------------------------------------------
     # data-plane handlers (binary fast path; see cluster/dataplane.py)
     # ------------------------------------------------------------------
@@ -936,6 +974,7 @@ class ClusterNode:
         releases the origin's confirm barrier. Per-record hot path:
         resolved queues and decoded property headers memoize (origins
         re-send identical routes and props for streams of publishes)."""
+        await self._flow_stall()
         self.broker.metrics.rpc_data_bytes_recv += len(view)
         marks: list[tuple[int, int]] = []
         any_persisted = False

@@ -75,6 +75,40 @@ DEFAULTS: dict[str, Any] = {
     # 0 / null disables the gate (per-queue passivation still bounds memory).
     "chana.mq.memory.high-watermark": "512MiB",
     "chana.mq.memory.low-watermark": None,  # default: 80% of high
+    # flow-control ladder (chanamq_tpu/flow/): one MemoryAccountant sums
+    # every accounted resident cost (queue bodies, parked publishes,
+    # connection out-buffers, WAL memtable, data-plane buffers, stream
+    # cache) and degrades gracefully through four stages, mildest first:
+    #   1 page      > page-watermark:   page bodies to the store early
+    #   2 throttle  > high-watermark:   Channel.Flow(false) + publish
+    #                                   credit, then parked reads (the
+    #                                   legacy memory gate, now staged)
+    #   3 cluster   > cluster-watermark: shrink data-plane windows, stall
+    #                                   inbound cluster push batches
+    #   4 refuse    > refuse-watermark: refuse new publishes (406) while
+    #                                   consumers drain; /admin/health
+    #                                   goes not-ready
+    # Each stage exits at (enter * low/high) — the same hysteresis the
+    # binary gate had, so no stage can flap. The memory.high/low
+    # watermarks above anchor the ladder; these knobs tune the rest
+    # (None = derived defaults, shown beside each).
+    "chana.mq.flow.page-watermark": None,     # default 60% of high
+    "chana.mq.flow.cluster-watermark": None,  # default midway high->refuse
+    "chana.mq.flow.refuse-watermark": None,   # default 90% of hard
+    "chana.mq.flow.hard-limit": None,         # default 2x high
+    # bytes a throttled connection may still publish before its reads
+    # park (grace for clients honoring Channel.Flow); 0 = park at once
+    "chana.mq.flow.publish-credit": "256KiB",
+    # per-consumer delivery-buffer bound: a consumer whose unsent
+    # rendered deliveries exceed this is skipped by dispatch (and counted
+    # slow) until the connection's output buffer drains. 0 = unbounded.
+    "chana.mq.flow.consumer-buffer": "4MiB",
+    # per-connection parked-publish cap while the gate is closed
+    # (overrides the built-in 256KiB when set)
+    "chana.mq.flow.park-buffer": None,
+    # resident-per-queue cap while the ladder is at/above the page stage
+    # (tightens chana.mq.queue.max-resident under pressure)
+    "chana.mq.flow.page-resident": 256,
     "chana.mq.admin.enabled": True,
     "chana.mq.admin.interface": "127.0.0.1",
     "chana.mq.admin.port": 15672,
@@ -160,6 +194,7 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.alerts.stall-ticks": 3,        # zero-deliver ticks -> stall
     "chana.mq.alerts.repl-lag": 1000,        # events behind
     "chana.mq.alerts.loop-lag-ms": 250,      # event-loop lag
+    "chana.mq.alerts.memory-stage": 3.5,     # flow stage (fires at refuse)
     "chana.mq.cluster.enabled": False,
     "chana.mq.cluster.host": "127.0.0.1",
     "chana.mq.cluster.port": 25672,

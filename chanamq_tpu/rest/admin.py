@@ -320,22 +320,28 @@ class AdminServer:
     async def _health(self, query: dict):
         """Readiness probe: 200 when ready, 503 with reasons when not —
         pointable straight at a load balancer. Works without telemetry
-        (drain check only); ?scope=cluster adds every peer's verdict."""
+        (drain, shard, and memory-pressure checks only); ?scope=cluster
+        adds every peer's verdict."""
         svc = getattr(self.broker, "telemetry", None)
         if svc is not None:
             out = svc.health()
         else:
-            from ..telemetry.health import shard_check
+            from ..telemetry.health import flow_check, shard_check
 
             draining = bool(getattr(self.broker, "draining", False))
             reasons = (["draining: shutdown in progress"]
                        if draining else [])
             checks: dict = {"draining": {"ok": not draining}}
-            # shard-sibling liveness needs no telemetry, only membership
+            # shard-sibling liveness and the overload ladder need no
+            # telemetry, only membership / the accountant
             shards = shard_check(self.broker)
             if shards is not None:
                 checks["shards"], shard_reasons = shards
                 reasons.extend(shard_reasons)
+            pressure = flow_check(self.broker)
+            if pressure is not None:
+                checks["memory_pressure"], flow_reasons = pressure
+                reasons.extend(flow_reasons)
             out = {"node": self.broker.trace_node, "live": True,
                    "ready": not reasons, "reasons": reasons,
                    "checks": checks}

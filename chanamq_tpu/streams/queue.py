@@ -178,6 +178,19 @@ class StreamQueue(Queue):
         """Sealed segments + the active one when it holds records."""
         return len(self._segments) + (1 if self._active else 0)
 
+    @property
+    def cache_bytes(self) -> int:
+        """Resident stream bytes: the active segment plus every sealed
+        segment whose record blob is cached in RAM. Polled once per broker
+        sweep tick as the flow accountant's ``stream_cache`` component —
+        computed, not incrementally tracked, so it can never drift from
+        the seal/evict/hydrate paths it observes."""
+        total = self._active_bytes
+        for seg in self._segments:
+            if seg.records is not None:
+                total += seg.size_bytes
+        return total
+
     def cursor_lag(self, name: str) -> int:
         """Records between a cursor's committed offset and the log tail."""
         committed = self.committed.get(name)

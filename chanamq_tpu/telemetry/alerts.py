@@ -58,8 +58,13 @@ def default_rules(
     stall_ticks: int = 3,
     repl_lag: float = 1000.0,
     loop_lag_ms: float = 250.0,
+    memory_stage: float = 3.5,
 ) -> list[AlertRule]:
-    """The four built-in rules, thresholds from chana.mq.alerts.*."""
+    """The built-in rules, thresholds from chana.mq.alerts.*.
+
+    memory-pressure alerts on the flow ladder's REFUSE stage (stage 4 >
+    3.5) by default — throttling (stage 2) is routine overload shedding
+    and would be noisy; refusing publishes is operator-actionable."""
     return [
         AlertRule(
             name="backlog-growth", scope="queue", metric="depth",
@@ -75,6 +80,9 @@ def default_rules(
         AlertRule(
             name="loop-lag", scope="node", metric="loop_lag_ms",
             threshold=loop_lag_ms, for_ticks=2, severity="critical"),
+        AlertRule(
+            name="memory-pressure", scope="node", metric="memory_stage",
+            threshold=memory_stage, for_ticks=2, severity="critical"),
     ]
 
 

@@ -37,6 +37,30 @@ def shard_check(broker: "Broker") -> "tuple[dict, list[str]] | None":
     return check, reasons
 
 
+def flow_check(broker: "Broker") -> "tuple[dict, list[str]] | None":
+    """Memory-pressure ladder state, usable with or without telemetry
+    (the /admin/health fallback needs it too — a default-config broker at
+    the refuse stage must not read as ready). The stage is always
+    surfaced (so the LB / operator sees "throttle" building), but
+    readiness only drops at the refuse stage — a throttling broker is
+    still doing useful work, and flipping it not-ready would redirect
+    load it is actively shedding. None when no watermark is configured."""
+    flow = broker.flow
+    if flow is None:
+        return None
+    from ..flow import STAGE_REFUSE
+
+    refusing = flow.stage >= STAGE_REFUSE
+    check = {
+        "ok": not refusing, "stage": flow.stage,
+        "stage_label": flow.label, "accounted_bytes": flow.total,
+        "hard_limit": flow.hard_limit}
+    reasons = ([f"memory pressure: stage {flow.label} "
+                f"({flow.total} accounted / hard limit {flow.hard_limit})"]
+               if refusing else [])
+    return check, reasons
+
+
 def evaluate_health(broker: "Broker", svc: "TelemetryService") -> dict:
     reasons: list[str] = []
     checks: dict[str, dict] = {}
@@ -65,6 +89,11 @@ def evaluate_health(broker: "Broker", svc: "TelemetryService") -> dict:
     if recent:
         reasons.append(f"store: {recent} background write failure(s) "
                        f"in the last {svc.store_error_window} ticks")
+
+    pressure = flow_check(broker)
+    if pressure is not None:
+        checks["memory_pressure"], flow_reasons = pressure
+        reasons.extend(flow_reasons)
 
     cluster = broker.cluster
     repl_lag = 0

@@ -248,10 +248,12 @@ class TelemetryService:
         cluster = broker.cluster
         if cluster is not None and cluster.replication is not None:
             repl_lag = float(cluster.replication.total_lag())
+        flow = broker.flow
         return {
             "loop_lag_ms": self.loop_lag_ms,
             "repl_lag_events": repl_lag,
             "store_errors": float(self.store_errors_recent),
+            "memory_stage": float(flow.stage) if flow is not None else 0.0,
         }
 
     def _evaluate_alerts(self, probes: dict[str, float]) -> None:

@@ -17,7 +17,7 @@ from __future__ import annotations
 from typing import Optional
 
 from .runtime import (  # noqa: F401  (package API)
-    CLUSTER_PUSH, DELIVER, ENQUEUE, FLUSH_WAIT, INGRESS_PARSE,
+    CLUSTER_PUSH, DELIVER, ENQUEUE, FLOW_THROTTLE, FLUSH_WAIT, INGRESS_PARSE,
     INTRA_SHARD_HOP, REMOTE_APPLY, REPLICATE_SHIP, ROUTE, SETTLE, STAGE_KEYS,
     STAGES, WAL_APPEND, WAL_COMMIT, Trace, TraceRuntime, decode_trailer,
     encode_trailer,

@@ -42,6 +42,7 @@ STAGES = (
     "intra-shard-hop",  # UDS hop between sibling shards on one node
     "wal-append",       # encode + buffer a WAL record (synchronous)
     "wal-commit",       # the group write+fsync that made it durable
+    "flow-throttle",    # publish parked at the overload gate before run
 )
 INGRESS_PARSE = 0
 ROUTE = 1
@@ -55,6 +56,7 @@ SETTLE = 8
 INTRA_SHARD_HOP = 9
 WAL_APPEND = 10
 WAL_COMMIT = 11
+FLOW_THROTTLE = 12
 
 STAGE_KEYS = tuple("trace_" + s.replace("-", "_") + "_us" for s in STAGES)
 
@@ -250,6 +252,10 @@ class TraceRuntime:
         # stamped by the connection read loop; begin_publish discards it
         # when stale (previous chunk, idle connection)
         self.ingress_ns = 0
+        # (t0, t1) stamped by a connection releasing held publishes; the
+        # first sampled publish after the release carries the span, then
+        # it is consumed (one park episode -> one flow-throttle span)
+        self.flow_ns: Optional[tuple] = None
         self.ring: deque = deque(maxlen=self.ring_size)
         self.slow: deque = deque(maxlen=self.ring_size)
         self._inflight: "OrderedDict[str, Trace]" = OrderedDict()
@@ -280,6 +286,14 @@ class TraceRuntime:
         if not t0 or t0 > now or now - t0 > 50_000_000:
             t0 = now  # stale stamp: connection idle or different conn
         tr.span(INGRESS_PARSE, t0, now, node)
+        flow = self.flow_ns
+        if flow is not None:
+            self.flow_ns = None
+            f0, f1 = flow
+            if f1 <= now and now - f1 <= 50_000_000:
+                # same staleness bound as ingress: the span belongs to the
+                # publish stream released just now, not an old episode
+                tr.span(FLOW_THROTTLE, f0, f1, node)
         self.current = tr
         if self.metrics is not None:
             self.metrics.trace_sampled += 1

@@ -159,6 +159,23 @@ class Metrics:
         self.shard_cross_pushes = 0
         self.shard_handoffs = 0
         self.shard_restarts = 0
+        # overload protection (chanamq_tpu/flow/): ladder transitions,
+        # stage-1 pressure paging, stage-2 throttle signals and the time
+        # publishes spend parked, stage-3 cluster stalls, stage-4
+        # refusals, and per-consumer delivery-buffer saturation. All
+        # zero unless a flow watermark is configured.
+        self.flow_escalations = 0
+        self.flow_deescalations = 0
+        self.flow_paged_bodies = 0
+        self.flow_paged_bytes = 0
+        self.flow_throttles = 0
+        self.flow_resumes = 0
+        self.flow_hold_releases = 0
+        self.flow_hold_wait_ns = 0
+        self.flow_cluster_stalls = 0
+        self.flow_publishes_refused = 0
+        self.flow_slow_consumers = 0
+        self.chaos_pressure = 0
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -249,6 +266,18 @@ class Metrics:
             "shard_cross_pushes": self.shard_cross_pushes,
             "shard_handoffs": self.shard_handoffs,
             "shard_restarts": self.shard_restarts,
+            "flow_escalations": self.flow_escalations,
+            "flow_deescalations": self.flow_deescalations,
+            "flow_paged_bodies": self.flow_paged_bodies,
+            "flow_paged_bytes": self.flow_paged_bytes,
+            "flow_throttles": self.flow_throttles,
+            "flow_resumes": self.flow_resumes,
+            "flow_hold_releases": self.flow_hold_releases,
+            "flow_hold_wait_ns": self.flow_hold_wait_ns,
+            "flow_cluster_stalls": self.flow_cluster_stalls,
+            "flow_publishes_refused": self.flow_publishes_refused,
+            "flow_slow_consumers": self.flow_slow_consumers,
+            "chaos_pressure": self.chaos_pressure,
             "wal_appends": self.wal_appends,
             "wal_append_bytes": self.wal_append_bytes,
             "wal_commits": self.wal_commits,

@@ -356,6 +356,13 @@ class WalStore(StoreService):
         self._compacted_thru: dict[tuple[str, str], int] = {}
         self.recovered_records = 0
 
+    @property
+    def memtable_pending_bytes(self) -> int:
+        """Accounted-memory gauge for the flow ladder: bytes staged in the
+        memtable awaiting the next index drain (Broker._flow_tick polls
+        this once per sweep)."""
+        return self._pending_bytes
+
     def __getattr__(self, name):
         # anything WalStore doesn't reimplement (diagnostics such as
         # ``synchronous``/``_submit``, the cluster_kv helpers) falls

@@ -43,6 +43,28 @@ grep -q '"fired_rules": \["backlog-growth", "consumer-stall"\]' /tmp/_t1_chaos.j
     exit 1
 }
 
+echo "tier1: overload soak smoke (~7 s: memory-pressure chaos, refuse + recover)"
+# the soak itself fails (violation -> exit 1) on confirmed loss, missing
+# refusals/paging, or a broken channel.flow resume; the grep double-checks
+# the broker stayed under the accounted-byte ceiling in the report
+timeout -k 10 180 python bench.py --overload --seed 7 \
+        | tee /tmp/_t1_overload.json || {
+    rc=$?
+    echo "tier1: overload soak smoke FAILED (rc=$rc) — flow-ladder invariant violation" >&2
+    exit "$rc"
+}
+grep -q '"under_hard_limit": true' /tmp/_t1_overload.json || {
+    echo "tier1: overload soak exceeded the accounted-byte hard limit" >&2
+    exit 1
+}
+
+echo "tier1: connection-churn smoke (500 cycles: no accounted-bytes leak)"
+timeout -k 10 180 python bench.py --churn || {
+    rc=$?
+    echo "tier1: connection-churn smoke FAILED (rc=$rc) — accounted-bytes leak" >&2
+    exit "$rc"
+}
+
 echo "tier1: telemetry overhead smoke (5 s x2: per-entity sampling <= 2%)"
 # the off/on delta is measured from two independent 5 s runs, so on a
 # shared/virtualized box a CPU-steal burst in either run can swamp the
