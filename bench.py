@@ -1401,6 +1401,91 @@ def main() -> None:
             sys.exit(1)  # the tier-1 smoke must fail loudly
         return
 
+    if "--control-overhead" in sys.argv:
+        # predictive-control cost: the headline transient/autoAck spec
+        # with the telemetry stack on, vs the same plus the control plane
+        # ticking at 100 ms (10x the default rate). The hot path never
+        # sees the control plane — gather is one loop callback, the
+        # evaluation runs on its own executor — so the claim is the same
+        # <= 2% budget the telemetry sampler is held to.
+        spec = "transient_autoack_3p3c"
+        base_env = {"CHANAMQ_TELEMETRY_ENABLED": "true",
+                    "CHANAMQ_TELEMETRY_INTERVAL": "100ms"}
+        runs = {}
+        for label, extra in (
+            ("off", dict(base_env)),
+            ("on", {**base_env,
+                    "CHANAMQ_CONTROL_ENABLED": "true",
+                    "CHANAMQ_CONTROL_INTERVAL": "100ms"}),
+        ):
+            runs[label] = run_spec(spec, extra_env=extra)
+            print(f"# control_overhead {label}: {runs[label]}",
+                  file=sys.stderr)
+        base = runs["off"].get("delivered_per_s") or 0
+        cur = runs["on"].get("delivered_per_s")
+        delta = (round((cur - base) / base * 100, 2)
+                 if base and cur is not None else None)
+        errors = {k: v["error"] for k, v in runs.items() if "error" in v}
+        over_budget = delta is not None and delta < -2.0
+        print(json.dumps({
+            "metric": "control_overhead_pct",
+            "value": delta,
+            "unit": "%",
+            "vs_baseline": None,
+            "delivered_per_s": {
+                k: v.get("delivered_per_s") for k, v in runs.items()},
+            "body_bytes": BODY_BYTES,
+            "budget_pct": -2.0,
+            "within_budget": not over_budget,
+            **({"error": errors} if errors else {}),
+        }))
+        if errors or over_budget:
+            sys.exit(1)  # > 2% throughput loss fails the smoke
+        return
+
+    if "--control" in sys.argv:
+        # predictive-control spike soak: one seeded burst ramp replayed
+        # uncontrolled, controlled (twice, same seed) and dry-run
+        # (chanamq_tpu/chaos/soak.py run_control_soak). The controlled
+        # runs must peak strictly below the uncontrolled maximum stage
+        # with strictly fewer refusals, the same-seed decision logs must
+        # compare byte-identical, the dry run must mutate nothing, and
+        # no run may lose a confirmed message; any violation exits 1.
+        seed = 7
+        if "--seed" in sys.argv:
+            seed = int(sys.argv[sys.argv.index("--seed") + 1])
+        from chanamq_tpu.chaos.soak import run_control_soak
+
+        try:
+            result = asyncio.run(asyncio.wait_for(
+                run_control_soak(seed), timeout=180))
+        except Exception as exc:
+            result = {"seed": seed,
+                      "violations": [f"{type(exc).__name__}: {exc}"]}
+        print(f"# control_soak: {result}", file=sys.stderr)
+        off = result.get("off") or {}
+        on = result.get("on") or {}
+        print(json.dumps({
+            "metric": "control_spike_stage_delta",
+            "value": (off.get("max_stage") - on.get("max_stage")
+                      if off.get("max_stage") is not None
+                      and on.get("max_stage") is not None else None),
+            "unit": "stages",
+            "vs_baseline": None,
+            "seed": seed,
+            "off_max_stage": off.get("max_stage"),
+            "on_max_stage": on.get("max_stage"),
+            "off_refused": off.get("publishes_refused"),
+            "on_refused": on.get("publishes_refused"),
+            "off_peak_bytes": off.get("peak_bytes"),
+            "on_peak_bytes": on.get("peak_bytes"),
+            "decision_log_sha256": on.get("log_sha256"),
+            "control_soak": result,
+        }))
+        if result.get("violations") or not on:
+            sys.exit(1)  # the tier-1 smoke must fail loudly
+        return
+
     if "--churn" in sys.argv:
         # connection-churn leak check: N connect/declare-exclusive/publish/
         # disconnect cycles (half abrupt aborts), then the memory

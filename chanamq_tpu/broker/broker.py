@@ -100,6 +100,9 @@ class Broker:
         # set by chanamq_tpu.telemetry.service.TelemetryService when
         # per-entity sampling is on (chana.mq.telemetry.enabled)
         self.telemetry = None
+        # set by chanamq_tpu.control.ControlService when the predictive
+        # control plane is on (chana.mq.control.enabled)
+        self.control = None
         # broker-wide entity gauges, maintained incrementally at every queue
         # mutation site (entities.py / streams/queue.py) so a sampler tick is
         # O(1) instead of a walk over every queue in every vhost
@@ -368,6 +371,7 @@ class Broker:
             flow = self.flow
             snap["flow_stage"] = flow.stage
             snap["flow_stage_label"] = flow.label
+            snap["flow_stage_floor"] = flow.floor
             snap["flow_total_bytes"] = flow.total
             snap["flow_peak_bytes"] = flow.peak_total
             snap["flow_hard_limit"] = flow.hard_limit
@@ -377,6 +381,8 @@ class Broker:
             snap["repl_lag_events"] = self.cluster.replication.total_lag()
         if self.telemetry is not None:
             snap.update(self.telemetry.gauges())
+        if self.control is not None:
+            snap.update(self.control.gauges())
         return snap
 
     # -- lifecycle ---------------------------------------------------------

@@ -391,6 +391,7 @@ async def run_node(config) -> None:
     cluster = None
     forecaster = None
     telemetry = None
+    control = None
     started = False
     stop_event = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -533,6 +534,46 @@ async def run_node(config) -> None:
                     if telemetry is not None else 0),
             )
             await forecaster.start()
+        if config.bool("chana.mq.control.enabled"):
+            # predictive control plane (control/): forecast/trend-driven
+            # admission pre-arm, queue rebalancing and prefetch
+            # autotuning. Boots after telemetry + forecaster (its inputs)
+            # and works degraded without either — trend-only admission
+            # against the flow ladder. Dry-run by default.
+            from ..control import ControlService
+
+            control = ControlService(
+                server.broker,
+                interval_s=config.duration_s("chana.mq.control.interval")
+                or 1.0,
+                dry_run=config.bool("chana.mq.control.dry-run"),
+                admission=config.bool("chana.mq.control.admission.enabled"),
+                rebalance=config.bool("chana.mq.control.rebalance.enabled"),
+                prefetch=config.bool("chana.mq.control.prefetch.enabled"),
+                horizon_s=config.duration_s("chana.mq.control.horizon")
+                or 5.0,
+                arm_ticks=config.int("chana.mq.control.arm-ticks"),
+                cooldown_s=config.duration_s("chana.mq.control.cooldown")
+                or 10.0,
+                rebalance_cooldown_s=config.duration_s(
+                    "chana.mq.control.rebalance.cooldown") or 30.0,
+                credit_factor=float(config.get(
+                    "chana.mq.control.admission.credit-factor") or 0.5),
+                credit_min=config.size_bytes(
+                    "chana.mq.control.admission.credit-min") or 4096,
+                rebalance_ratio=float(config.get(
+                    "chana.mq.control.rebalance.ratio") or 1.5),
+                rebalance_min_rate=float(config.size_bytes(
+                    "chana.mq.control.rebalance.min-rate") or 1024),
+                prefetch_min=config.int("chana.mq.control.prefetch.min"),
+                prefetch_max=config.int("chana.mq.control.prefetch.max"),
+                log_size=config.int("chana.mq.control.log-size"),
+                forecast_max_age_s=config.duration_s(
+                    "chana.mq.control.forecast-max-age") or 10.0,
+                forecast_error_gate=float(config.get(
+                    "chana.mq.control.forecast-error-gate") or 0.5),
+            )
+            await control.start()
         if config.bool("chana.mq.admin.enabled"):
             admin = AdminServer(
                 server.broker,
@@ -551,6 +592,8 @@ async def run_node(config) -> None:
         server.broker.draining = True
         if admin:
             await admin.stop()
+        if control:
+            await control.stop()
         if telemetry:
             await telemetry.stop()
         if forecaster:

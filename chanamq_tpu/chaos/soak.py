@@ -783,6 +783,362 @@ async def run_overload_soak(
             pass
 
 
+# -- predictive-control spike soak -----------------------------------------
+
+# seeded burst ramp: doubling bursts guarantee the two in-flight bursts
+# after the reactive throttle crossing (the "frames already on the wire"
+# lag) dwarf the refuse-enter gap, so the uncontrolled run always lands
+# at the refuse stage while the pre-armed run stops two bursts earlier
+# and peaks inside the throttle band — for every seed's +/-10% jitter.
+_CTRL_BURSTS = 7
+_CTRL_BURST_BASE = 12 * 1024
+_CTRL_SPIKE_TICKS = 10
+_CTRL_BURST_LAG = 2          # bursts that still land after a stop decision
+_CTRL_BODY_PAD = 1024        # + 8-byte tag = 1032 accounted bytes/message
+_CTRL_PRE = 32               # confirmed publishes before the spike
+_CTRL_POST = 8               # confirmed publishes after recovery
+_CTRL_PROBES = 3             # refusal-probe publishes at the peak
+_CTRL_CREDIT = 16 * 1024     # publish credit the pre-arm must shrink/restore
+
+
+def control_spike_sizes(seed: int) -> list[int]:
+    """The seeded injection schedule: a doubling ramp with +/-10% jitter.
+    Pure function of the seed — both on-runs replay it identically."""
+    import random
+    rng = random.Random(seed)
+    sizes = []
+    for i in range(_CTRL_BURSTS):
+        sizes.append(int(_CTRL_BURST_BASE * (2 ** i) * rng.uniform(0.9, 1.1)))
+    return sizes
+
+
+async def _control_spike_run(seed: int, mode: str) -> dict:
+    """One seeded spike episode. mode: "off" (no control plane), "on"
+    (control applying decisions), "dry" (control logging but provably
+    mutating nothing). Returns a report with per-run violations plus the
+    raw decision-log bytes for cross-run comparison."""
+    from ..amqp.properties import BasicProperties
+    from ..broker.broker import Broker
+    from ..broker.server import BrokerServer
+    from ..client.client import AMQPClient
+    from ..control import ControlService
+    from ..flow import STAGE_THROTTLE
+    from ..store.memory import MemoryStore
+    from ..telemetry import TelemetryService
+    from ..telemetry.alerts import default_rules as alert_defaults
+
+    broker = Broker(
+        store=MemoryStore(),
+        # no background sweeps: accounting moves only on the synchronous
+        # publish/ack path, so the gate-total series (and therefore the
+        # decision log) is a pure function of the seed
+        message_sweep_interval_s=3600.0,
+        # keep every body resident (no passivation, pager opted out): the
+        # spike must confront the admission ladder head-on, not drain
+        # into the store through the stage-1 pager mid-ramp
+        queue_max_resident=1_000_000,
+        flow_page_resident=0,
+        flow_high_watermark=256 * 1024,
+        flow_refuse_watermark=700 * 1024,
+        flow_hard_limit=4 * 1024 * 1024,
+        flow_publish_credit=_CTRL_CREDIT,
+        flow_consumer_buffer=4 * 1024 * 1024,
+    )
+    flow = broker.flow
+    srv = BrokerServer(broker=broker, host="127.0.0.1", port=0,
+                       heartbeat_s=0)
+    # harness-ticked telemetry (the control plane reads its ring); every
+    # alert threshold is unreachable so firings can't vary the run
+    broker.telemetry = TelemetryService(
+        broker, interval_s=1.0, ring_ticks=64,
+        rules=alert_defaults(backlog_growth=1e12, stall_ticks=10**6,
+                             repl_lag=1e12, loop_lag_ms=1e12,
+                             memory_stage=1e12))
+    svc = broker.telemetry
+
+    control = None
+    if mode != "off":
+        control = ControlService(
+            broker, interval_s=1.0, dry_run=(mode == "dry"),
+            admission=True, rebalance=False, prefetch=False,
+            horizon_s=12.0, arm_ticks=2, cooldown_s=6.0,
+            credit_factor=0.5, credit_min=4096, log_size=512)
+
+    max_stage = {"v": 0}
+    flow.listeners.append(
+        lambda old, new: max_stage.__setitem__("v", max(max_stage["v"], new)))
+
+    violations: list[str] = []
+    conns: list = []
+    qn = "ctrl_q"
+    pad = b"x" * _CTRL_BODY_PAD
+    msg_bytes = _CTRL_BODY_PAD + 8
+    props = BasicProperties()
+    deliveries: dict[bytes, int] = {}
+    floor_max = 0
+
+    async def wait_for(predicate, timeout: float, what: str) -> bool:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while not predicate():
+            if asyncio.get_event_loop().time() > deadline:
+                violations.append(f"[{mode}] timeout waiting for {what}")
+                return False
+            await asyncio.sleep(0.01)
+        return True
+
+    try:
+        await srv.start()
+
+        # -- pre-phase: a confirmed baseline backlog (the zero-loss set)
+        p1 = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        conns.append(p1)
+        p1_ch = await p1.channel()
+        await p1_ch.confirm_select()
+        await p1_ch.queue_declare(qn)
+        for i in range(_CTRL_PRE):
+            p1_ch.basic_publish(b"p1-%05d" % i + pad, routing_key=qn)
+        await p1_ch.wait_unconfirmed_below(1, timeout=15)
+        confirmed: set[bytes] = {b"p1-%05d" % i for i in range(_CTRL_PRE)}
+
+        # -- spike: seeded doubling bursts, injected synchronously so the
+        # accountant sees the exact same byte series every run. The
+        # injector stops once it observes stage >= THROTTLE at a tick
+        # start, but the next _CTRL_BURST_LAG bursts still land — the
+        # in-flight frames a real publisher has already sent. The earlier
+        # the ladder throttles, the lower the peak: that delta is what
+        # separates the pre-armed run from the reactive one.
+        sizes = control_spike_sizes(seed)
+        injected = 0
+        stop_tick = None
+        for t in range(_CTRL_SPIKE_TICKS):
+            if stop_tick is None and flow.stage >= STAGE_THROTTLE:
+                stop_tick = t
+            if t < len(sizes) and (stop_tick is None
+                                   or t < stop_tick + _CTRL_BURST_LAG):
+                for _ in range(max(1, sizes[t] // msg_bytes)):
+                    routed, _ = broker.publish_sync(
+                        "/", "", qn, props, b"inj-%04d" % injected + pad)
+                    if not routed:
+                        violations.append(f"[{mode}] injected publish "
+                                          f"{injected} not routed")
+                    injected += 1
+            svc.sample_tick(1.0)
+            if control is not None:
+                await control.step(1.0)
+                floor_max = max(floor_max, flow.floor)
+            await asyncio.sleep(0.01)
+        spike_peak = flow.peak_total
+
+        # -- refusal probe at the peak: an uncontrolled run sits at the
+        # refuse stage (406 channel close); a pre-armed run sits at the
+        # throttle floor and accepts the probe under the shrunk credit
+        pb = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        conns.append(pb)
+        pb_ch = await pb.channel()
+        for i in range(_CTRL_PROBES):
+            try:
+                pb_ch.basic_publish(b"pb-%05d" % i + pad, routing_key=qn)
+            except Exception:
+                break  # channel already closed by a 406
+        if mode == "on":
+            await asyncio.sleep(0.3)
+            if broker.metrics.flow_publishes_refused:
+                violations.append(
+                    f"[{mode}] pre-armed run refused "
+                    f"{broker.metrics.flow_publishes_refused} publishes")
+        else:
+            await wait_for(
+                lambda: broker.metrics.flow_publishes_refused > 0, 10,
+                "a refused publish at the uncontrolled peak")
+
+        # -- drain: consumer attaches, backlog empties to a quiescent gate
+        c_conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        conns.append(c_conn)
+        c_ch = await c_conn.channel()
+        await c_ch.basic_qos(prefetch_count=64)
+
+        def on_msg(msg):
+            deliveries[bytes(msg.body[:8])] = \
+                deliveries.get(bytes(msg.body[:8]), 0) + 1
+            c_ch.basic_ack(msg.delivery_tag)
+
+        await c_ch.basic_consume(qn, on_msg, consumer_tag="ctrl")
+        await wait_for(lambda: flow.components.get("bodies", 0) == 0, 30,
+                       "full backlog drain")
+
+        # -- recovery: at the quiescent barrier (gate total is exactly 0,
+        # so the relax inputs are identical every run) tick the control
+        # plane until the engine disarms — the relax decision
+        if control is not None:
+            for _ in range(10):
+                if not control.engine.snapshot()["armed"]:
+                    break
+                await control.step(1.0)
+                floor_max = max(floor_max, flow.floor)
+            if control.engine.snapshot()["armed"]:
+                violations.append(f"[{mode}] engine never disarmed at the "
+                                  f"quiescent barrier")
+        await wait_for(lambda: flow.stage == 0, 15,
+                       "stage-0 recovery after the drain")
+        await wait_for(lambda: p1_ch.flow_events == [False, True], 10,
+                       "channel.flow stop/resume pair on the publisher")
+
+        # -- post-phase: confirms flow again after the episode
+        for i in range(_CTRL_POST):
+            p1_ch.basic_publish(b"p1-%05d" % (_CTRL_PRE + i) + pad,
+                                routing_key=qn)
+        await p1_ch.wait_unconfirmed_below(1, timeout=15)
+        confirmed |= {b"p1-%05d" % (_CTRL_PRE + i)
+                      for i in range(_CTRL_POST)}
+        await wait_for(lambda: confirmed <= set(deliveries), 30,
+                       "every confirmed message delivered")
+        missing = sorted(confirmed - set(deliveries))
+        if missing:
+            violations.append(
+                f"[{mode}] confirmed-but-lost: {len(missing)} messages "
+                f"(first: {[m.decode() for m in missing[:5]]})")
+        if flow.peak_total > flow.hard_limit:
+            violations.append(
+                f"[{mode}] accounted peak {flow.peak_total} exceeded the "
+                f"hard limit {flow.hard_limit}")
+
+        m = broker.metrics
+        return {
+            "mode": mode,
+            "seed": seed,
+            "injected": injected,
+            "max_stage": max_stage["v"],
+            "spike_peak_bytes": spike_peak,
+            "peak_bytes": flow.peak_total,
+            "publishes_refused": m.flow_publishes_refused,
+            "decisions": m.control_decisions,
+            "applied": m.control_applied,
+            "suppressed": m.control_suppressed,
+            "dry_runs": m.control_dry_run,
+            "control_errors": m.control_errors,
+            "floor_max": floor_max,
+            "floor_end": flow.floor,
+            "credit_end": broker.flow_publish_credit,
+            "confirmed": len(confirmed),
+            "delivered_unique": len(set(deliveries) & confirmed),
+            "log_bytes": (control.decision_log_bytes()
+                          if control is not None else b""),
+            "violations": violations,
+        }
+    finally:
+        if control is not None:
+            try:
+                await control.stop()
+            except Exception:
+                pass
+        for conn in conns:
+            try:
+                await conn.close()
+            except Exception:
+                pass
+        try:
+            await srv.stop()
+        except Exception:
+            pass
+
+
+async def run_control_soak(seed: int) -> dict:
+    """Predictive-control spike soak: the same seeded byte-for-byte burst
+    ramp is replayed four times — uncontrolled, controlled, controlled
+    again (same seed), and dry-run — and the runs are compared. The
+    report's ``violations`` list is empty iff:
+
+    1. **The pre-armed run beats the reactive ladder** — strictly lower
+       maximum flow stage and strictly fewer refused publishes than the
+       uncontrolled run (which must actually reach the refuse stage, or
+       the spike proved nothing).
+    2. **Zero confirmed-message loss in every run.**
+    3. **The decision log is deterministic** — the two same-seed
+       controlled runs serialize byte-identically, and non-trivially
+       (at least pre-arm + relax).
+    4. **Dry-run mutates nothing** — decisions are logged and counted,
+       but the stage floor never moves, the publish credit is untouched,
+       nothing is applied, and the broker behaves exactly like the
+       uncontrolled run (same max stage, refusals still happen).
+    """
+    import hashlib
+
+    off = await _control_spike_run(seed, "off")
+    on = await _control_spike_run(seed, "on")
+    on2 = await _control_spike_run(seed, "on")
+    dry = await _control_spike_run(seed, "dry")
+
+    violations: list[str] = []
+    for run in (off, on, on2, dry):
+        violations.extend(run.pop("violations"))
+
+    from ..flow import STAGE_REFUSE, STAGE_THROTTLE
+    if off["publishes_refused"] == 0 or off["max_stage"] < STAGE_REFUSE:
+        violations.append(
+            f"uncontrolled run never hit the refuse stage "
+            f"(max_stage={off['max_stage']}, "
+            f"refused={off['publishes_refused']})")
+    for run in (on, on2):
+        if run["max_stage"] >= off["max_stage"]:
+            violations.append(
+                f"pre-armed max stage {run['max_stage']} not strictly "
+                f"below uncontrolled {off['max_stage']}")
+        if run["publishes_refused"] >= max(1, off["publishes_refused"]):
+            violations.append(
+                f"pre-armed run refused {run['publishes_refused']} "
+                f"publishes (uncontrolled: {off['publishes_refused']})")
+        if run["max_stage"] > STAGE_THROTTLE:
+            violations.append(
+                f"pre-armed run escalated past the throttle floor "
+                f"(max_stage={run['max_stage']})")
+        if run["applied"] < 2:
+            violations.append(
+                f"controlled run applied only {run['applied']} decisions "
+                f"(expected pre-arm + relax)")
+        if run["floor_end"] != 0 or run["credit_end"] != _CTRL_CREDIT:
+            violations.append(
+                f"relax did not restore state: floor={run['floor_end']} "
+                f"credit={run['credit_end']}")
+    if not on["log_bytes"]:
+        violations.append("controlled run produced an empty decision log")
+    if on["log_bytes"] != on2["log_bytes"]:
+        violations.append(
+            "same-seed decision logs differ between controlled runs")
+    if dry["decisions"] < 1 or dry["dry_runs"] < 1:
+        violations.append("dry-run logged no decisions")
+    if dry["applied"] != 0:
+        violations.append(
+            f"dry-run applied {dry['applied']} decisions")
+    if dry["floor_max"] != 0:
+        violations.append(
+            f"dry-run moved the stage floor (floor_max={dry['floor_max']})")
+    if dry["credit_end"] != _CTRL_CREDIT:
+        violations.append(
+            f"dry-run changed the publish credit ({dry['credit_end']})")
+    if dry["max_stage"] != off["max_stage"] or dry["publishes_refused"] == 0:
+        violations.append(
+            f"dry-run behavior diverged from uncontrolled "
+            f"(max_stage={dry['max_stage']} vs {off['max_stage']}, "
+            f"refused={dry['publishes_refused']})")
+
+    def digest(run: dict) -> None:
+        raw = run.pop("log_bytes")
+        run["log_sha256"] = hashlib.sha256(raw).hexdigest()
+        run["log_len"] = len(raw)
+
+    for run in (off, on, on2, dry):
+        digest(run)
+    return {
+        "seed": seed,
+        "sizes": control_spike_sizes(seed),
+        "off": off,
+        "on": on,
+        "on_repeat": on2,
+        "dry": dry,
+        "violations": violations,
+    }
+
+
 async def run_connection_churn(cycles: int = 500, *,
                                bodies_per_cycle: int = 3,
                                body_bytes: int = 2048) -> dict:

@@ -357,17 +357,23 @@ class ClusterNode:
             "holder": self.name,
         }
 
-    def _set_holder(self, vhost: str, name: str, holder: Optional[str]) -> None:
+    def _set_holder(self, vhost: str, name: str, holder: Optional[str],
+                    decision: Optional[str] = None) -> None:
         """Record + replicate who serves a queue (None = released: the
-        hash ring decides again)."""
+        hash ring decides again). A control-plane rebalance stamps its
+        decision id on the broadcast so every node's log links the move
+        back to the decision (and its recorded inputs)."""
         self.broker.invalidate_routes()
         meta = self.queue_metas.get((vhost, name))
         if meta is not None:
             meta["holder"] = holder
-        self.broadcast_bg("meta.apply", {
+        payload = {
             "kind": "queue.holder", "vhost": vhost, "name": name,
             "holder": holder,
-        })
+        }
+        if decision is not None:
+            payload["decision"] = decision
+        self.broadcast_bg("meta.apply", payload)
 
     def claim_queue(self, queue: "Queue") -> None:
         """Called by the broker when a queue materializes locally
@@ -378,6 +384,70 @@ class ClusterNode:
         self._set_holder(queue.vhost, queue.name, self.name)
         if self.replication is not None:
             self.replication.attach(queue)
+
+    async def handoff_queue(self, vhost_name: str, name: str, target: str,
+                            *, decision: Optional[str] = None) -> bool:
+        """Proactively move holdership of a local queue to ``target`` (a
+        control-plane rebalance decision). Reuses the exact machinery of
+        the boot-time dual-copy drop (_deactivate_unowned): release the
+        local copy's RAM accounting WITHOUT unreferring (the store rows
+        now belong to the new holder), replicate the holder change, then
+        activate on the target so it rematerializes durable content from
+        the shared store. Callers must pre-check movability (no local
+        consumers, no outstanding, durable-persisted content only) — this
+        re-verifies and refuses rather than losing data."""
+        broker = self.broker
+        vhost = broker.vhosts.get(vhost_name)
+        queue = vhost.queues.get(name) if vhost is not None else None
+        if queue is None or queue.deleted or queue.is_stream:
+            return False
+        if queue.exclusive_owner is not None or queue.outstanding:
+            return False
+        if (vhost_name, name) not in self.queue_metas:
+            return False
+        if target == self.name or self.membership is None \
+                or not self.membership.is_alive(target):
+            return False
+        if any(not isinstance(c, RemoteConsumer) for c in queue.consumers):
+            return False  # local AMQP consumers cannot follow the queue
+        if queue.messages and (
+                not queue.durable
+                or any(not qm.message.persisted for qm in queue.messages)):
+            return False  # transient content would not survive the move
+        # detach remote-consumer stubs; their origins re-register on the
+        # new holder when the queue.holder broadcast lands
+        for consumer in list(queue.consumers):
+            queue.consumers.remove(consumer)
+            if queue._counted:
+                broker.queue_consumers -= 1
+        for qm in queue.messages:
+            msg = qm.message
+            if msg.accounted:
+                broker.account_memory(-len(msg.body or b""))
+                msg.accounted = False
+        queue.deleted = True
+        queue.gauges_detach()
+        del vhost.queues[name]
+        if self.replication is not None:
+            self.replication.detach(vhost_name, name)
+        self._set_holder(vhost_name, name, target, decision=decision)
+        # this node may itself consume from the moved queue
+        if any(key[0] == vhost_name and key[1] == name
+               for key in self._remote_consumers):
+            asyncio.get_event_loop().create_task(self._reconcile_consumers())
+        try:
+            await self._call(target, "queue.activate",
+                             {"vhost": vhost_name, "name": name})
+        except (RpcError, OSError) as exc:
+            # holdership already points at the target: it will activate
+            # lazily on the first proxied op instead
+            log.warning("%s: handoff activate on %s failed (%s); "
+                        "target will lazy-activate", self.name, target, exc)
+            return False
+        log.info("%s: handed off %s/%s -> %s%s", self.name, vhost_name,
+                 name, target,
+                 f" (decision {decision})" if decision else "")
+        return True
 
     # ------------------------------------------------------------------
     # membership reactions
@@ -582,6 +652,7 @@ class ClusterNode:
         rpc.register("consumer.credit", self._h_consumer_credit)
         rpc.register("consumer.cancelled", self._h_consumer_cancelled)
         rpc.register("telemetry.pull", self._h_telemetry_pull)
+        rpc.register("control.load", self._h_control_load)
         # data plane: binary zero-copy bodies, no field-table codec
         rpc.register_binary(dp.METHOD_PUSH_MANY, self._hb_push_many)
         rpc.register_binary(dp.METHOD_SETTLE_MANY, self._hb_settle_many)
@@ -783,9 +854,23 @@ class ClusterNode:
             }
             return {}
         if kind == "queue.holder":
-            meta = self.queue_metas.get((vhost_name, str(payload["name"])))
+            name = str(payload["name"])
+            meta = self.queue_metas.get((vhost_name, name))
             if meta is not None:
                 meta["holder"] = payload.get("holder")
+            decision = payload.get("decision")
+            if decision:
+                # a proactive control-plane move, not a failure/ring event
+                log.info("%s: holder of %s/%s -> %s (control decision %s)",
+                         self.name, vhost_name, name,
+                         payload.get("holder"), decision)
+            if any(key[0] == vhost_name and key[1] == name
+                   for key in self._remote_consumers):
+                # a queue this node consumes from moved: re-register the
+                # consumer on the new holder without waiting for the next
+                # membership event
+                asyncio.get_event_loop().create_task(
+                    self._reconcile_consumers())
             return {}
         if kind == "queue.deleted":
             name = str(payload["name"])
@@ -1425,6 +1510,14 @@ class ClusterNode:
         window = max(1, min(int(payload.get("window", 60)), 4096))
         top = max(0, int(payload.get("top", 0)))
         return svc.local_payload(window, top)
+
+    async def _h_control_load(self, payload: dict) -> dict:
+        """Serve this node's inflow-load figure (bytes/s EWMA) to a peer's
+        control plane evaluating a rebalance decision."""
+        control = getattr(self.broker, "control", None)
+        return {"node": self.name,
+                "load": float(control.load_rate) if control is not None
+                else 0.0}
 
     async def remote_cancel(self, vhost: str, name: str, tag: str) -> None:
         info = self._remote_consumers.pop((vhost, name, tag), None)

@@ -171,6 +171,38 @@ DEFAULTS: dict[str, Any] = {
     # telemetry rings. 0 = node-total features only; >0 requires
     # chana.mq.telemetry.enabled.
     "chana.mq.forecast.queue-top-k": 0,
+    # predictive control plane (control/): closes the forecast->actuation
+    # loop. Each interval a ControlService snapshots flow-ladder state,
+    # telemetry and (when fresh + trusted) the forecast, evaluates
+    # off-loop, and emits hysteresis-guarded decisions: predictive
+    # admission (pre-arm the stage-2 throttle + shrink publish credit
+    # before the watermark), proactive queue rebalancing (holdership
+    # handoff toward the cluster mean), and prefetch autotuning (nudge
+    # the consume-credit window). Off by default; dry-run by default when
+    # on — decisions are logged + counted but actuate nothing until
+    # dry-run is lifted (the rollout path; also POST /admin/control).
+    "chana.mq.control.enabled": False,
+    "chana.mq.control.dry-run": True,
+    "chana.mq.control.interval": "1s",
+    "chana.mq.control.horizon": "5s",        # projection lookahead
+    "chana.mq.control.arm-ticks": 2,         # consecutive trigger ticks
+    "chana.mq.control.cooldown": "10s",      # per-kind decision spacing
+    "chana.mq.control.admission.enabled": True,
+    "chana.mq.control.admission.credit-factor": 0.5,
+    "chana.mq.control.admission.credit-min": "4KB",
+    "chana.mq.control.rebalance.enabled": True,
+    "chana.mq.control.rebalance.ratio": 1.5,  # self vs cluster-mean load
+    "chana.mq.control.rebalance.min-rate": "1KB",  # bytes/s floor
+    "chana.mq.control.rebalance.cooldown": "30s",
+    "chana.mq.control.prefetch.enabled": True,
+    "chana.mq.control.prefetch.min": 8,
+    "chana.mq.control.prefetch.max": 256,
+    "chana.mq.control.log-size": 256,        # retained decisions
+    "chana.mq.control.forecast-max-age": "10s",
+    # trust gate: use the forecast only while its publish-bytes-rate MAE
+    # stays under this fraction of the observed inflow; otherwise fall
+    # back to the reactive trend
+    "chana.mq.control.forecast-error-gate": 0.5,
     # per-entity telemetry (telemetry/): fixed-slot timeseries ring per
     # queue and per connection, sampled off the hot path each interval;
     # event-loop lag + sampler saturation probes; /admin/timeseries,

@@ -98,6 +98,12 @@ class MemoryAccountant:
         self.exit = tuple(e * lw // hw for e in self.enter)
         self.components: dict[str, int] = {name: 0 for name in COMPONENTS}
         self.stage = STAGE_NORMAL
+        # minimum stage pinned by the predictive control plane
+        # (chanamq_tpu/control/): a pre-arm decision raises the floor so
+        # throttling engages BEFORE the watermark, through the exact same
+        # listener/actuation chain as a reactive crossing; clearing it
+        # lets the ladder settle back to the accounted total
+        self.floor = STAGE_NORMAL
         self.total = 0
         self.peak_total = 0
         # fired as fn(old_stage, new_stage) on every transition
@@ -139,6 +145,8 @@ class MemoryAccountant:
         if stage == self.stage:
             while stage > STAGE_NORMAL and gate_total <= self.exit[stage]:
                 stage -= 1
+        if stage < self.floor:
+            stage = self.floor
         if stage == self.stage:
             return
         old, self.stage = self.stage, stage
@@ -171,6 +179,7 @@ class MemoryAccountant:
         return {
             "stage": self.stage,
             "stage_label": self.label,
+            "floor": self.floor,
             "total_bytes": self.total,
             "peak_bytes": self.peak_total,
             "high_watermark": self.high_watermark,

@@ -33,8 +33,8 @@ from typing import TYPE_CHECKING, Any, Optional
 import numpy as np
 
 from .telemetry import (
-    FEATURES, TelemetryRing, counter_state, normalization, sample,
-    training_batch,
+    FEATURES, TelemetryRing, TopKSlots, counter_state, normalization,
+    sample, training_batch,
 )
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -67,10 +67,13 @@ class ForecastService:
         self.batch = batch
         # per-queue awareness: widen each sample with (depth, publish_rate)
         # of the K busiest queues from the per-entity telemetry rings
-        # (broker.telemetry). Slot columns are rank-ordered ("the busiest
-        # queue"), not name-bound, so the feature space stays fixed-width
-        # as queues come and go. Zeros when telemetry is off.
+        # (broker.telemetry). Slot columns are PINNED to queue identity
+        # (TopKSlots): a slot keeps tracking the same queue while it stays
+        # in the top-K set, with explicit eviction + a one-tick zero reset
+        # on reassignment, so a training window never splices two queues'
+        # series into one column. Zeros when telemetry is off.
         self.queue_top_k = queue_top_k
+        self.topk = TopKSlots(queue_top_k)
         self.feature_names: tuple[str, ...] = FEATURES + tuple(
             name
             for i in range(queue_top_k)
@@ -108,6 +111,14 @@ class ForecastService:
         self.rounds = 0
         self.updated_at: Optional[float] = None
         self.last_error: Optional[str] = None
+        # forecast accuracy: each realized tick is scored against the
+        # forecast that predicted it (per-feature absolute error; running
+        # MAE). The control plane gates actuation on this, and operators
+        # see it at GET /admin/forecast + chanamq_forecast_error_* gauges.
+        self._pending_forecast: Optional[np.ndarray] = None
+        self.error_scored = 0
+        self.error_last: Optional[np.ndarray] = None
+        self.error_mae: Optional[np.ndarray] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -151,10 +162,11 @@ class ForecastService:
                 if self.queue_top_k:
                     telemetry = getattr(self.broker, "telemetry", None)
                     extra = (
-                        telemetry.topk_features(self.queue_top_k)
+                        self.topk.update(*telemetry.queues.latest_matrix())
                         if telemetry is not None
                         else np.zeros(2 * self.queue_top_k, dtype=np.float32))
                     vec = np.concatenate([vec, extra])
+                self.score_tick(vec)
                 self.ring.push(vec)
                 if (now >= next_train and not self._round_inflight
                         and len(self.ring) >= self.seq_len + 1):
@@ -197,6 +209,52 @@ class ForecastService:
         self.forecast = forecast
         self.updated_at = time.time()
         self.last_error = None
+        # the next realized tick scores this forecast (score_tick)
+        self._pending_forecast = np.array(
+            [forecast[name] for name in self.feature_names],
+            dtype=np.float32)
+
+    # -- forecast accuracy (event loop; numpy only) ------------------------
+
+    def score_tick(self, vec: np.ndarray) -> None:
+        """Score the pending next-tick forecast against the realized
+        vector: per-feature absolute error, folded into a running MAE.
+        A forecast is consumed by the first tick that follows it."""
+        pending = self._pending_forecast
+        if pending is None or len(pending) != len(vec):
+            return
+        self._pending_forecast = None
+        err = np.abs(np.asarray(vec, dtype=np.float32) - pending)
+        self.error_last = err
+        self.error_scored += 1
+        if self.error_mae is None:
+            self.error_mae = err.copy()
+        else:
+            self.error_mae += (err - self.error_mae) / self.error_scored
+        # NaN/inf can only come from a poisoned forecast; drop the stats
+        # rather than serving non-finite gauges
+        if not np.isfinite(err).all():
+            self.error_last = None
+            self.error_mae = None
+            self.error_scored = 0
+
+    def accuracy(self) -> Optional[dict[str, Any]]:
+        if not self.error_scored or self.error_mae is None:
+            return None
+        return {
+            "scored": self.error_scored,
+            "mae": {name: float(v) for name, v in
+                    zip(self.feature_names, self.error_mae)},
+            "last_abs_error": (
+                {name: float(v) for name, v in
+                 zip(self.feature_names, self.error_last)}
+                if self.error_last is not None else None),
+        }
+
+    def slot_queues(self) -> list:
+        """Queue identity pinned to each top-K feature slot (None=free);
+        lets the control plane map top{i}_* forecasts back to queues."""
+        return self.topk.slot_queues()
 
     # -- train/predict round (worker thread; owns all JAX state) -----------
 
@@ -278,6 +336,10 @@ class ForecastService:
                  for name, v in zip(self.feature_names, observed)}
                 if observed is not None else None),
             "forecast": self.forecast,
+            "accuracy": self.accuracy(),
+            "slot_queues": [
+                list(key) if key is not None else None
+                for key in self.topk.slot_queues()],
             "updated_at": self.updated_at,
             "error": self.last_error,
         }

@@ -120,6 +120,78 @@ class TelemetryRing:
         return self._buf[(self._next - 1) % self.capacity].copy()
 
 
+class TopKSlots:
+    """Identity-pinned feature slots for the per-queue forecaster columns.
+
+    The old tap (TelemetryService.topk_features) re-ranked queues every
+    tick and wrote "the i-th busiest queue" into slot i. Whenever the
+    top-K *set* changed between ticks, a feature column silently changed
+    meaning mid-window — the model saw queue A's depth spliced onto
+    queue B's history and trained on the seam. Here a slot, once
+    assigned, stays bound to the same queue for as long as that queue
+    remains in the top-K set; membership changes are explicit:
+
+    - eviction: a queue that drops out of the current top-K frees its
+      slot (the slot emits zeros from that tick on),
+    - reset: a newly assigned slot emits zeros for exactly one tick (the
+      reset marker), so the window shows a clean break instead of a
+      discontinuous splice between two queues' series.
+
+    Assignment of new entrants to freed slots follows rank order, so the
+    mapping is deterministic for a given telemetry series.
+    """
+
+    def __init__(self, k: int) -> None:
+        self.k = max(0, int(k))
+        self._keys: list[Optional[tuple]] = [None] * self.k
+
+    def slot_queues(self) -> list[Optional[tuple]]:
+        """Current slot -> queue identity binding (None = free)."""
+        return list(self._keys)
+
+    def update(self, keys: list, latest: np.ndarray) -> np.ndarray:
+        """One tick: re-rank, evict/assign, and emit the 2k feature tail
+        (depth, publish_rate per slot) aligned to the pinned bindings.
+
+        keys/latest are EntityRings.latest_matrix() output (QUEUE_FIELDS
+        column order: publish_rate, deliver_rate, ack_rate, depth, ...).
+        """
+        out = np.zeros(2 * self.k, dtype=np.float32)
+        if self.k == 0:
+            return out
+        desired: list[tuple] = []
+        if keys:
+            rate = latest[:, 0] + latest[:, 1]
+            order = np.argsort(-rate, kind="stable")[: self.k]
+            desired = [tuple(keys[i]) for i in order]
+        desired_set = set(desired)
+        # evict slots whose queue left the top-K set
+        freed: list[int] = []
+        for slot, key in enumerate(self._keys):
+            if key is not None and key not in desired_set:
+                self._keys[slot] = None
+            if self._keys[slot] is None:
+                freed.append(slot)
+        # assign new entrants to freed slots in rank order; fresh slots
+        # emit zeros this tick (the reset marker)
+        occupied = {key for key in self._keys if key is not None}
+        entrants = [key for key in desired if key not in occupied]
+        fresh: set[int] = set()
+        for slot, key in zip(freed, entrants):
+            self._keys[slot] = key
+            fresh.add(slot)
+        index = {tuple(key): i for i, key in enumerate(keys)}
+        for slot, key in enumerate(self._keys):
+            if key is None or slot in fresh:
+                continue
+            row = index.get(key)
+            if row is None:
+                continue  # vanished this tick; evicted on the next update
+            out[2 * slot] = latest[row, 3]      # depth
+            out[2 * slot + 1] = latest[row, 0]  # publish_rate
+        return out
+
+
 def training_batch(
     history: np.ndarray, seq_len: int, batch: int, rng: np.random.Generator
 ) -> Optional[tuple[np.ndarray, np.ndarray]]:

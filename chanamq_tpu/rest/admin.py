@@ -194,6 +194,10 @@ class AdminServer:
             return ("GET", self._replication)
         if rest == ["forecast"]:
             return ("GET", self._forecast)
+        if rest == ["control"]:
+            return ("GET", lambda: self._control(query))
+        if rest == ["control", "configure"]:
+            return ("POST", lambda: self._control_configure(body))
         if rest == ["chaos"]:
             return ("GET", self._chaos_status)
         if rest == ["chaos", "install"]:
@@ -471,6 +475,40 @@ class AdminServer:
             return {"enabled": False}
         return forecaster.snapshot()
 
+    def _control(self, query: dict):
+        control = getattr(self.broker, "control", None)
+        if control is None:
+            return {"enabled": False}
+        tail = self._q_int(query, "log", 32, 0, 4096)
+        return control.snapshot(tail=tail)
+
+    def _control_configure(self, body: bytes) -> dict:
+        """Runtime knobs for the rollout path: observe decisions with
+        {"dry-run": true} (the boot default), then lift it without a
+        restart once the log looks right."""
+        control = getattr(self.broker, "control", None)
+        if control is None:
+            raise AdminError(
+                "409 Conflict",
+                "control disabled: boot with chana.mq.control.enabled")
+        try:
+            req = json.loads(body or b"{}")
+        except ValueError as exc:
+            raise AdminError("400 Bad Request", f"bad json: {exc}")
+        if not isinstance(req, dict):
+            raise AdminError("400 Bad Request", "body must be an object")
+        if "dry-run" in req:
+            control.dry_run = bool(req["dry-run"])
+        for feature in ("admission", "rebalance", "prefetch"):
+            if feature in req:
+                setattr(control, f"{feature}_enabled", bool(req[feature]))
+        return {"ok": True, "dry_run": control.dry_run,
+                "features": {
+                    "admission": control.admission_enabled,
+                    "rebalance": control.rebalance_enabled,
+                    "prefetch": control.prefetch_enabled,
+                }}
+
     # metric name -> prometheus type; everything else in the snapshot is a
     # gauge. Latency percentiles remain exported as computed gauges for
     # dashboards that predate the proper histogram series; every Histogram
@@ -496,6 +534,8 @@ class AdminServer:
         "telemetry_evicted_entities", "telemetry_dropped_entities",
         "alerts_fired", "alerts_resolved",
         "shard_cross_pushes", "shard_handoffs", "shard_restarts",
+        "control_ticks", "control_decisions", "control_applied",
+        "control_suppressed", "control_dry_run", "control_errors",
     })
 
     @staticmethod
@@ -604,6 +644,26 @@ class AdminServer:
             if forecaster.loss is not None:
                 out.append("# TYPE chanamq_forecast_loss gauge")
                 out.append(f"chanamq_forecast_loss {forecaster.loss}")
+        if forecaster is not None:
+            accuracy = forecaster.accuracy()
+            if accuracy is not None:
+                # realized accuracy of past forecasts (models/service.py
+                # score_tick): the series the control plane gates on
+                out.append("# TYPE chanamq_forecast_error_scored counter")
+                out.append(
+                    f"chanamq_forecast_error_scored {accuracy['scored']}")
+                out.append("# TYPE chanamq_forecast_error_mae gauge")
+                for name, value in accuracy["mae"].items():
+                    out.append(
+                        f"chanamq_forecast_error_mae"
+                        f'{{feature="{self._prom_label(name)}"}} {value}')
+                last = accuracy.get("last_abs_error")
+                if last:
+                    out.append("# TYPE chanamq_forecast_error_last gauge")
+                    for name, value in last.items():
+                        out.append(
+                            f"chanamq_forecast_error_last"
+                            f'{{feature="{self._prom_label(name)}"}} {value}')
         return "\n".join(out) + "\n"
 
     def _overview(self) -> dict:

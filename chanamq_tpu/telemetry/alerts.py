@@ -59,6 +59,7 @@ def default_rules(
     repl_lag: float = 1000.0,
     loop_lag_ms: float = 250.0,
     memory_stage: float = 3.5,
+    control_floor_ticks: int = 300,
 ) -> list[AlertRule]:
     """The built-in rules, thresholds from chana.mq.alerts.*.
 
@@ -83,6 +84,15 @@ def default_rules(
         AlertRule(
             name="memory-pressure", scope="node", metric="memory_stage",
             threshold=memory_stage, for_ticks=2, severity="critical"),
+        # predictive-control watchdog: a pre-armed throttle floor is
+        # supposed to relax within a spike's horizon; one pinned for this
+        # many consecutive ticks means the forecast is stuck pessimistic
+        # or the relax path is broken. The default (5 min at 1 s ticks)
+        # keeps it inert in short soaks — it exists for real deployments.
+        AlertRule(
+            name="control-prearm-stuck", scope="node",
+            metric="control_floor", threshold=0.5,
+            for_ticks=max(1, control_floor_ticks), severity="warning"),
     ]
 
 

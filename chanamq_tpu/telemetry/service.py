@@ -254,6 +254,10 @@ class TelemetryService:
             "repl_lag_events": repl_lag,
             "store_errors": float(self.store_errors_recent),
             "memory_stage": float(flow.stage) if flow is not None else 0.0,
+            # stage floor pinned by the predictive control plane; the
+            # control-prearm-stuck rule watches for a floor that never
+            # relaxes (forecast stuck pessimistic / relax path broken)
+            "control_floor": float(flow.floor) if flow is not None else 0.0,
         }
 
     def _evaluate_alerts(self, probes: dict[str, float]) -> None:
@@ -364,10 +368,12 @@ class TelemetryService:
     # -- forecaster feature tap --------------------------------------------
 
     def topk_features(self, k: int) -> np.ndarray:
-        """2k extra forecaster features: (depth, publish_rate) for each of
-        the top-k queues by publish+deliver rate, zero-padded. Slot order
-        is rate-ranked, so the forecaster sees "the busiest queue" as a
-        stable feature column even as which queue that is changes."""
+        """2k extra features: (depth, publish_rate) for each of the top-k
+        queues by publish+deliver rate, zero-padded, rank-ordered. NOTE:
+        rank-ordered slots change meaning whenever the top-K set churns —
+        the forecaster therefore samples through models.telemetry.TopKSlots
+        (identity-pinned slots with explicit eviction/reset) instead; this
+        rank-ordered view remains for ad-hoc "busiest right now" reads."""
         out = np.zeros(2 * k, dtype=np.float32)
         keys, latest = self.queues.latest_matrix()
         if not keys or k <= 0:

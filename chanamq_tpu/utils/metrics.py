@@ -175,6 +175,16 @@ class Metrics:
         self.flow_cluster_stalls = 0
         self.flow_publishes_refused = 0
         self.flow_slow_consumers = 0
+        # predictive control plane (chanamq_tpu/control/): ticks evaluated,
+        # decisions emitted by the engine, decisions actually actuated,
+        # triggers blocked by hysteresis/cooldown, decisions recorded in
+        # dry-run without actuation, and apply/tick failures
+        self.control_ticks = 0
+        self.control_decisions = 0
+        self.control_applied = 0
+        self.control_suppressed = 0
+        self.control_dry_run = 0
+        self.control_errors = 0
         self.chaos_pressure = 0
         self.started_at = time.time()
 
@@ -277,6 +287,12 @@ class Metrics:
             "flow_cluster_stalls": self.flow_cluster_stalls,
             "flow_publishes_refused": self.flow_publishes_refused,
             "flow_slow_consumers": self.flow_slow_consumers,
+            "control_ticks": self.control_ticks,
+            "control_decisions": self.control_decisions,
+            "control_applied": self.control_applied,
+            "control_suppressed": self.control_suppressed,
+            "control_dry_run": self.control_dry_run,
+            "control_errors": self.control_errors,
             "chaos_pressure": self.chaos_pressure,
             "wal_appends": self.wal_appends,
             "wal_append_bytes": self.wal_append_bytes,
