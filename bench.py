@@ -1363,6 +1363,44 @@ def main() -> None:
             sys.exit(1)  # the tier-1 smoke must fail loudly
         return
 
+    if "--elastic" in sys.argv:
+        # elasticity chaos soak: 3-node cluster + joiner on private
+        # per-node stores (chanamq_tpu/chaos/soak.py run_elastic_soak) —
+        # join-triggered rebalance, graceful drain/decommission, kill -9
+        # mid-drain, and a healed partition fencing off a stale owner.
+        # The episode runs TWICE with the same seed and the normalized
+        # decision/evacuation logs must be byte-identical; any invariant
+        # violation (confirmed loss, dual holders, unfenced stale ship,
+        # non-contiguous stream resume) exits non-zero.
+        seed = 11
+        if "--seed" in sys.argv:
+            seed = int(sys.argv[sys.argv.index("--seed") + 1])
+        from chanamq_tpu.chaos.soak import run_elastic_soak
+
+        try:
+            result = asyncio.run(asyncio.wait_for(
+                run_elastic_soak(seed), timeout=240))
+        except Exception as exc:
+            result = {"seed": seed,
+                      "violations": [f"{type(exc).__name__}: {exc}"]}
+        runs = [{k: v for k, v in run.items() if k != "log_bytes"}
+                for run in result.get("runs", [])]
+        print(f"# elastic_soak: violations={result.get('violations')} "
+              f"log_sha256={result.get('log_sha256')}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "elastic_soak_violations",
+            "value": len(result.get("violations", [])),
+            "unit": "violations",
+            "vs_baseline": None,
+            "seed": seed,
+            "log_sha256": result.get("log_sha256"),
+            "runs": runs,
+            "violations": result.get("violations", []),
+        }))
+        if result.get("violations"):
+            sys.exit(1)  # the tier-1 smoke must fail loudly
+        return
+
     if "--overload" in sys.argv:
         # overload soak: a deterministic memory-pressure chaos rule drives
         # the flow ladder to the refuse stage under a saturating publisher

@@ -840,6 +840,7 @@ class Broker:
             ))
         if self.cluster is not None and exclusive_owner is None:
             self.cluster._register_meta(queue)
+            epoch = self.cluster.seat_epoch(vhost_name, name)
             if self.cluster.replication is not None and not queue.is_stream:
                 # per-queue replication mirrors the ready deque; stream
                 # durability is the segment log itself
@@ -848,7 +849,7 @@ class Broker:
                 "kind": "queue.declared", "vhost": vhost_name, "name": name,
                 "durable": durable, "auto_delete": auto_delete,
                 "ttl_ms": ttl_ms, "arguments": arguments,
-                "holder": self.cluster.name,
+                "holder": self.cluster.name, "epoch": epoch,
             })
         return queue
 

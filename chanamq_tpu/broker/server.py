@@ -456,6 +456,14 @@ async def run_node(config) -> None:
                 consume_credit=config.int("chana.mq.cluster.consume-credit"),
                 call_timeout_s=config.duration_s(
                     "chana.mq.cluster.call-timeout") or 10.0,
+                drain_retry_limit=config.int(
+                    "chana.mq.lifecycle.drain-retry-limit"),
+                drain_backoff_ms=int((config.duration_s(
+                    "chana.mq.lifecycle.drain-backoff") or 0.1) * 1000),
+                drain_backoff_cap_ms=int((config.duration_s(
+                    "chana.mq.lifecycle.drain-backoff-cap") or 2.0) * 1000),
+                drain_budget_s=config.duration_s(
+                    "chana.mq.lifecycle.drain-budget") or 30.0,
                 uds_path=(shard_topo.uds_path(shard_index)
                           if shard_topo is not None else None),
                 uds_map=(shard_topo.uds_map_for(shard_index)

@@ -1299,6 +1299,538 @@ async def _alert_phase(srv, cl, violations: list[str]) -> dict:
             pass
 
 
+async def _elastic_run(seed: int) -> dict:
+    """One elasticity episode: 3-node cluster + joiner, join-triggered
+    rebalance, graceful drain, kill -9 mid-drain, and a fenced stale
+    owner — all on PRIVATE per-node stores. Returns a report plus the
+    normalized decision/evacuation log bytes for same-seed comparison."""
+    import hashlib
+
+    from ..amqp.properties import BasicProperties
+    from ..client.client import AMQPClient
+    from ..broker.broker import Broker
+    from ..broker.server import BrokerServer
+    from ..cluster.membership import LEFT
+    from ..cluster.node import ClusterNode
+    from ..control import ControlService
+    from ..store.memory import MemoryStore
+    from ..telemetry import TelemetryService
+    from ..telemetry.alerts import default_rules as alert_defaults
+
+    # node names are host:port and feed the hash ring, so every placement
+    # choice (follower sets, evacuation targets, promotion winners) is a
+    # function of the ports. Ephemeral ports would make same-seed runs
+    # diverge; fixed seed-derived ports (below the 32768+ ephemeral range)
+    # make the whole episode replayable byte-for-byte. Only the cluster
+    # RPC port matters — the AMQP listener stays ephemeral.
+    cluster_base = 23000 + (seed % 512) * 8
+
+    async def start_node(seeds, port):
+        # flow ladder present (the control plane projects against it) but
+        # with watermarks far above the workload: stage stays 0 throughout
+        broker = Broker(store=MemoryStore(),
+                        flow_high_watermark=1 << 40,
+                        flow_hard_limit=1 << 42)
+        srv = BrokerServer(broker=broker, host="127.0.0.1", port=0,
+                           heartbeat_s=0)
+        await srv.start()
+        cl = ClusterNode(srv.broker, "127.0.0.1", port, seeds,
+                         heartbeat_interval_s=0.2, failure_timeout_s=1.5,
+                         replicate_factor=2, replicate_sync=True,
+                         replicate_ack_timeout_ms=2000,
+                         drain_budget_s=20.0)
+        await cl.start()
+        return srv, cl
+
+    async def until(predicate, timeout, what):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while not predicate():
+            if asyncio.get_event_loop().time() > deadline:
+                violations.append(f"timeout waiting for {what}")
+                return False
+            await asyncio.sleep(0.05)
+        return True
+
+    persistent = BasicProperties(delivery_mode=2)
+    violations: list[str] = []
+    conns: list = []
+    a_srv = a_cl = b_srv = b_cl = c_srv = c_cl = d_srv = d_cl = None
+    control = None
+    try:
+        a_srv, a_cl = await start_node([], cluster_base)
+        b_srv, b_cl = await start_node([a_cl.name], cluster_base + 1)
+        c_srv, c_cl = await start_node([a_cl.name], cluster_base + 2)
+        await until(
+            lambda: all(len(cl.membership.alive_members()) == 3
+                        for cl in (a_cl, b_cl, c_cl)),
+            10, "3-node membership")
+
+        # -- queue placement, pinned by role so same-seed runs make the
+        #    same logical decisions despite ephemeral node names
+        def placed(ring, prefix, *roles):
+            want = [cl.name for cl in roles]
+            return next(
+                f"{prefix}{i}" for i in range(4000)
+                if ring.preference_entity(
+                    "q", "/", f"{prefix}{i}", len(want))[:len(want)] == want)
+
+        eq = [placed(a_cl.ring, f"eq{j}x", a_cl, b_cl) for j in range(3)]
+        cq = [placed(a_cl.ring, f"cq{j}x", c_cl, b_cl) for j in range(2)]
+
+        # -- control plane on A, harness-stepped (no timers): tick 1 now
+        #    so the join observed later counts as elasticity, not boot.
+        #    The eq queues are declared BEFORE the first sample so tick 2
+        #    sees real publish-rate deltas (a queue's first sample
+        #    baselines its counters at zero rate)
+        a_srv.broker.telemetry = TelemetryService(
+            a_srv.broker, interval_s=1.0, ring_ticks=64,
+            rules=alert_defaults(
+                backlog_growth=1e12, stall_ticks=10**6, repl_lag=1e12,
+                loop_lag_ms=1e12, memory_stage=1e12))
+        control = ControlService(
+            a_srv.broker, interval_s=1.0, dry_run=False,
+            admission=False, rebalance=True, prefetch=False)
+        decl = await AMQPClient.connect("127.0.0.1", a_srv.bound_port)
+        conns.append(decl)
+        decl_ch = await decl.channel()
+        for qname in eq:
+            await decl_ch.queue_declare(qname, durable=True)
+        await decl.close()
+        a_srv.broker.telemetry.sample_tick(1.0)
+        await control.step(1.0)
+
+        # -- confirmed backlog (the zero-loss set); body length is fixed
+        #    so byte-counters (and the load EWMA in the decision log) are
+        #    a pure function of message COUNTS, not of searched names
+        confirmed: dict[str, set] = {}
+        mseq = 0
+
+        async def fill(srv, qname, count):
+            nonlocal mseq
+            conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+            conns.append(conn)
+            ch = await conn.channel()
+            await ch.confirm_select()
+            await ch.queue_declare(qname, durable=True)
+            bodies = set()
+            for _ in range(count):
+                body = b"m%06d" % mseq
+                mseq += 1
+                ch.basic_publish(body, routing_key=qname,
+                                 properties=persistent)
+                bodies.add(body.decode())
+            await ch.wait_unconfirmed_below(1, timeout=20)
+            confirmed[qname] = bodies
+            await conn.close()
+
+        # distinct per-queue rates make the engine's busiest-queue pick
+        # unambiguous: eq[0] is always the join-seeding move
+        await fill(a_srv, eq[0], 30)
+        await fill(a_srv, eq[1], 20)
+        await fill(a_srv, eq[2], 10)
+        await fill(c_srv, cq[0], 12)
+        await fill(c_srv, cq[1], 12)
+
+        # -- crash plan: drain.tick fires once per evacuation attempt;
+        #    A's drain burns invocations 1-2 (eq[1], eq[2] — eq[0] will
+        #    have moved to the joiner), C's drain hits 3 (cq[0]) and the
+        #    crash lands on 4: C dies holding cq[1], half-drained
+        plan = FaultPlan(seed, [
+            FaultRule(name="kill-during-drain", kind="crash",
+                      sites=["drain.tick"], after=3, count=1,
+                      nodes=["victim"]),
+        ])
+        runtime = install(plan, metrics=b_srv.broker.metrics)
+        fingerprint = plan.fingerprint()
+        crashed = asyncio.Event()
+
+        def crash_victim() -> None:
+            crashed.set()
+            task = c_cl.lifecycle._task
+            if task is not None:
+                task.cancel()  # deterministic: cq[1] never hands off
+
+            async def _die():
+                for part in (c_cl, c_srv):
+                    try:
+                        await part.stop()
+                    except Exception:
+                        pass
+            asyncio.get_event_loop().create_task(_die())
+
+        runtime.on_crash("victim", crash_victim)
+
+        # -- phase: join. D comes up; the control plane seeds it with the
+        #    busiest movable queue through the normal holdership machinery
+        d_srv, d_cl = await start_node([a_cl.name], cluster_base + 3)
+        await until(
+            lambda: all(len(cl.membership.alive_members()) == 4
+                        for cl in (a_cl, b_cl, c_cl, d_cl)),
+            10, "4-node membership")
+        a_srv.broker.telemetry.sample_tick(1.0)
+        control.note_member_join(d_cl.name)
+        decisions = await control.step(1.0)
+        join_moves = [d for d in decisions
+                      if d["kind"] == "rebalance.move"
+                      and d["action"].get("join")]
+        if len(join_moves) != 1:
+            violations.append(
+                f"expected exactly 1 join-rebalance decision, "
+                f"saw {len(join_moves)}")
+        elif join_moves[0]["action"]["name"] != eq[0] \
+                or join_moves[0]["action"]["target"] != d_cl.name:
+            violations.append(
+                f"join move picked {join_moves[0]['action']} "
+                f"(wanted busiest {eq[0]} -> joiner)")
+        await until(
+            lambda: d_cl.queue_metas.get(("/", eq[0]), {}).get("holder")
+            == d_cl.name and eq[0] in d_srv.broker.vhosts["/"].queues,
+            10, "join move to materialize on the joiner")
+
+        # fencing-phase queue: owned by B with its replica on the joiner,
+        # declared on the 4-node ring so the follower really is D
+        fq = placed(b_cl.ring, "fqx", b_cl, d_cl)
+        await fill(b_srv, fq, 8)
+
+        # -- phase: graceful drain of A (zero-loss evacuation, then LEFT)
+        a_cl.lifecycle.drain()
+        a_report = await a_cl.lifecycle.wait(30)
+        if a_report["state"] != "drained" or a_report["queues_moved"] != 2 \
+                or a_report["failed"] or a_report["pinned"]:
+            violations.append(f"drain of A did not complete: {a_report}")
+        await until(
+            lambda: b_cl.membership.lifecycle_of(a_cl.name) == LEFT
+            and d_cl.membership.lifecycle_of(a_cl.name) == LEFT,
+            10, "A's `left` state to gossip")
+        if a_cl.name in b_cl.membership.placement_members():
+            violations.append("left node still placement-eligible on B")
+
+        # -- phase: kill -9 mid-drain. C evacuates cq[0], dies before
+        #    cq[1]; B (the replica) must promote the remainder
+        promotions_before = (a_srv.broker.metrics.repl_promotions
+                            + b_srv.broker.metrics.repl_promotions
+                            + c_srv.broker.metrics.repl_promotions
+                            + d_srv.broker.metrics.repl_promotions)
+        c_cl.lifecycle.drain()
+        try:
+            await c_cl.lifecycle.wait(20)
+        except (asyncio.CancelledError, asyncio.TimeoutError):
+            pass
+        if not crashed.is_set():
+            violations.append("kill-during-drain rule never fired")
+
+        # C's drain hands cq[0] to its best-synced replica — after the
+        # join reshuffle that can be B (the original follower) or D (the
+        # re-picked one); either way it must land on exactly one live node
+        def _cq0_landed() -> bool:
+            holder = b_cl.queue_metas.get(("/", cq[0]), {}).get("holder")
+            if holder == b_cl.name:
+                return cq[0] in b_srv.broker.vhosts["/"].queues
+            if holder == d_cl.name:
+                return cq[0] in d_srv.broker.vhosts["/"].queues
+            return False
+
+        await until(_cq0_landed, 10,
+                    "evacuated cq[0] to land on a live node (B or D)")
+        # the unmoved remainder cq[1] must be promoted by whichever node
+        # held its replica when C died (B originally; D after the join
+        # reshuffle re-picked followers)
+        def _cq1_promoted() -> bool:
+            holder = b_cl.queue_metas.get(("/", cq[1]), {}).get("holder")
+            if holder == b_cl.name:
+                return cq[1] in b_srv.broker.vhosts["/"].queues
+            if holder == d_cl.name:
+                return cq[1] in d_srv.broker.vhosts["/"].queues
+            return False
+
+        await until(_cq1_promoted, 10,
+                    "a survivor to promote the unmoved remainder cq[1]")
+        failovers = (a_srv.broker.metrics.repl_promotions
+                     + b_srv.broker.metrics.repl_promotions
+                     + c_srv.broker.metrics.repl_promotions
+                     + d_srv.broker.metrics.repl_promotions
+                     - promotions_before)
+        if failovers != 1:
+            violations.append(
+                f"expected exactly 1 failover promotion from the "
+                f"mid-drain crash, saw {failovers}")
+
+        # -- phase: partition heals into a fenced stale owner. B is
+        #    isolated control-plane-wise (heartbeats cancelled, inbound
+        #    pings and meta broadcasts fail) while its data plane still
+        #    reaches D; D promotes fq and bumps its epoch; B — still
+        #    thinking it owns — ships the stale epoch and must be refused.
+        #    First let every live follower ack B's log heads: any copy D
+        #    promotes during the partition is then content-complete. Acks
+        #    piggyback on ships, and a wholesale resync finishes silently
+        #    — probe the follower's applied seq like prepare_handoff does
+        async def _b_heads_synced() -> bool:
+            repl_mgr = b_cl.replication
+            for (vhost, name), r in list(repl_mgr._logs.items()):
+                for follower, acked in list(r.followers.items()):
+                    if not b_cl.membership.is_alive(follower):
+                        continue
+                    if acked >= r.seq:
+                        continue
+                    try:
+                        reply = await repl_mgr.client_for(follower).call(
+                            "repl.probe",
+                            {"vhost": vhost, "queue": name,
+                             "owner": b_cl.name},
+                            timeout_s=1.0)
+                        applied = int(reply.get("applied", 0))
+                        if applied > acked:
+                            r.followers[follower] = applied
+                    except Exception:
+                        return False
+                if r.live_ack_floor() < r.seq:
+                    return False
+            return True
+
+        sync_deadline = asyncio.get_event_loop().time() + 10
+        while not await _b_heads_synced():
+            if asyncio.get_event_loop().time() > sync_deadline:
+                violations.append(
+                    "timeout waiting for B's followers to sync to head "
+                    "before the partition")
+                break
+            await asyncio.sleep(0.05)
+        b_mem = b_cl.membership
+        if b_mem._task is not None:
+            b_mem._task.cancel()
+            b_mem._task = None
+        # freeze B's anti-entropy too: a pull from D mid-partition would
+        # hand it the promoted holdership through the side door and it
+        # would stand down before ever shipping a stale epoch
+        if b_cl._anti_entropy_task is not None:
+            b_cl._anti_entropy_task.cancel()
+            b_cl._anti_entropy_task = None
+
+        async def _refuse_rpc(payload):
+            raise OSError("isolated for the fencing phase")
+
+        b_cl.rpc.register("cluster.ping", _refuse_rpc)
+        b_cl.rpc.register("meta.apply", _refuse_rpc)
+        await until(
+            lambda: d_cl.queue_metas.get(("/", fq), {}).get("holder")
+            == d_cl.name and fq in d_srv.broker.vhosts["/"].queues,
+            15, "D to promote fq after B is isolated")
+        stale_conn = await AMQPClient.connect("127.0.0.1",
+                                              b_srv.bound_port)
+        conns.append(stale_conn)
+        stale_ch = await stale_conn.channel()
+        await stale_ch.confirm_select()
+        for i in range(3):
+            # stale-owner publishes: B appends locally and ships with its
+            # old epoch; confirms must NOT come back (D refuses the ship)
+            try:
+                await stale_ch.basic_publish_confirmed(
+                    b"stale%02d" % i, routing_key=fq,
+                    properties=persistent, timeout=1.5)
+                violations.append(
+                    f"stale owner B got publish {i} confirmed while "
+                    f"fenced off")
+            except Exception:
+                pass
+        refusals = d_srv.broker.metrics.lifecycle_stale_epoch_refused
+        if refusals < 1:
+            violations.append(
+                "no stale-epoch ship was refused during the partition")
+        # heal: B rejoins, learns the higher-epoch holdership via
+        # anti-entropy, and stands down
+        b_cl.rpc.register("cluster.ping", b_mem._on_ping)
+        b_cl.rpc.register("meta.apply", b_cl._h_meta_apply)
+        b_mem._task = asyncio.get_event_loop().create_task(
+            b_mem._heartbeat_loop())
+        b_cl._anti_entropy_task = asyncio.get_event_loop().create_task(
+            b_cl._anti_entropy_loop())
+        await until(
+            lambda: b_cl.membership.is_alive(d_cl.name)
+            and d_cl.membership.is_alive(b_cl.name),
+            10, "partition to heal")
+        await until(
+            lambda: b_cl.queue_metas.get(("/", fq), {}).get("holder")
+            == d_cl.name, 10, "healed B to adopt D's fenced holdership")
+
+        # -- quiesce: exactly one live holder per queue, cluster-wide.
+        #    Promotions taken while B was dark resolve through the epoch
+        #    merge (B stands down on every queue D out-claimed), so give
+        #    anti-entropy a bounded window to converge before asserting
+        live = [(a_srv, a_cl), (b_srv, b_cl), (d_srv, d_cl)]
+
+        def claimants(qname):
+            claims = []
+            for srv, cl in live:
+                meta = cl.queue_metas.get(("/", qname), {})
+                vhost = srv.broker.vhosts.get("/")
+                queue = vhost.queues.get(qname) if vhost else None
+                if meta.get("holder") == cl.name and queue is not None \
+                        and not queue.deleted:
+                    claims.append((srv, cl))
+            return claims
+
+        everything = eq + cq + [fq]
+        await until(
+            lambda: all(len(claimants(q)) == 1 for q in everything),
+            15, "exactly one live holder per queue at quiesce")
+        owners: dict[str, tuple] = {}
+        for qname in everything:
+            claims = claimants(qname)
+            if len(claims) != 1:
+                violations.append(
+                    f"queue {qname}: {len(claims)} live holders at "
+                    f"quiesce (want exactly 1)")
+            if claims:
+                owners[qname] = claims[0]
+
+        # -- zero confirmed loss: every confirmed body is consumable from
+        #    the queue's current holder
+        lost = 0
+        for qname, bodies in confirmed.items():
+            holder = owners.get(qname)
+            if holder is None:
+                lost += len(bodies)
+                continue
+            srv, _cl = holder
+            got: set = set()
+            done = asyncio.Event()
+            conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+            conns.append(conn)
+            ch = await conn.channel()
+            await ch.basic_qos(prefetch_count=256)
+
+            def on_msg(msg, got=got, want=bodies, done=done, ch=ch):
+                got.add(bytes(msg.body).decode())
+                ch.basic_ack(msg.delivery_tag)
+                if want <= got:
+                    done.set()
+
+            await ch.basic_consume(qname, on_msg,
+                                   consumer_tag="elastic-verify")
+            try:
+                await asyncio.wait_for(done.wait(), 10)
+            except asyncio.TimeoutError:
+                pass
+            missing = bodies - got
+            if missing:
+                lost += len(missing)
+                violations.append(
+                    f"queue {qname}: {len(missing)} confirmed messages "
+                    f"lost (first: {sorted(missing)[:3]})")
+            await conn.close()
+
+        # -- stream cursors survive the churn: a stream on B (never
+        #    drained, crash-promoted, isolated AND healed) must still
+        #    resume contiguously at committed+1
+        sq = next(f"esx{i}" for i in range(4000)
+                  if b_cl.ring.owner_entity("q", "/", f"esx{i}")
+                  == b_cl.name)
+        stream = await _stream_cursor_check(b_srv, sq, 30, violations)
+
+        # -- normalized decision/evacuation log: two same-seed runs must
+        #    serialize byte-identically once node names and searched queue
+        #    names are replaced by their logical roles
+        raw = (control.decision_log_bytes() + b"\n"
+               + a_cl.lifecycle.evacuation_log_bytes())
+        text = raw.decode()
+        aliases = [(a_cl.name, "<A>"), (b_cl.name, "<B>"),
+                   (c_cl.name, "<C>"), (d_cl.name, "<D>")]
+        aliases += [(name, f"<eq{j}>") for j, name in enumerate(eq)]
+        aliases += [(name, f"<cq{j}>") for j, name in enumerate(cq)]
+        aliases.append((fq, "<fq>"))
+        for actual, alias in sorted(aliases, key=lambda kv: -len(kv[0])):
+            text = text.replace(actual, alias)
+        log_bytes = text.encode()
+
+        metrics_all = [s.broker.metrics for s in (a_srv, b_srv, c_srv,
+                                                  d_srv)]
+        return {
+            "seed": seed,
+            "fingerprint": fingerprint,
+            "nodes": 4,
+            "store": "memory (private per node)",
+            "replicate_factor": 2,
+            "confirmed": sum(len(v) for v in confirmed.values()),
+            "queues": len(eq) + len(cq) + 1,
+            "join_moves": len(join_moves),
+            "drain_a": a_report,
+            "crashed": crashed.is_set(),
+            "failover_promotions": failovers,
+            "stale_epoch_refused": refusals,
+            "evacuated": sum(m.lifecycle_queues_evacuated
+                             for m in metrics_all),
+            "evacuation_retries": sum(m.lifecycle_evacuation_retries
+                                      for m in metrics_all),
+            "rollbacks": sum(m.lifecycle_rollbacks for m in metrics_all),
+            "join_rebalances": sum(m.lifecycle_join_rebalances
+                                   for m in metrics_all),
+            "stale_holders_cleared": sum(m.lifecycle_stale_holders_cleared
+                                         for m in metrics_all),
+            "lost": lost,
+            "stream": stream,
+            "log_bytes": log_bytes,
+            "log_sha256": hashlib.sha256(log_bytes).hexdigest(),
+            "violations": violations,
+        }
+    finally:
+        clear()
+        if control is not None:
+            try:
+                await control.stop()
+            except Exception:
+                pass
+        for conn in conns:
+            try:
+                await conn.close()
+            except Exception:
+                pass
+        for part in (d_cl, d_srv, c_cl, c_srv, b_cl, b_srv, a_cl, a_srv):
+            if part is not None:
+                try:
+                    await part.stop()
+                except Exception:
+                    pass
+
+
+async def run_elastic_soak(seed: int) -> dict:
+    """Elasticity chaos soak (``bench.py --elastic``): the same seeded
+    episode — join-triggered rebalance, graceful drain to ``left``,
+    kill -9 mid-drain, partition healing into a fenced stale owner — run
+    TWICE with the same seed. The report's ``violations`` list is empty
+    iff every run held:
+
+    1. **Zero confirmed loss** — every confirm-gated body is consumable
+       from its queue's final holder, across a join move, two drains, a
+       crash promotion, and a fenced partition.
+    2. **Exactly one live holder per queue at quiesce** — no queue ends
+       split-brained or orphaned.
+    3. **Fencing works** — the healed stale owner's ships were refused
+       (``lifecycle_stale_epoch_refused``) and it adopted the
+       higher-epoch holdership instead of clobbering it.
+    4. **Stream cursors resume contiguously** on the surviving node.
+    5. **The decision/evacuation log is deterministic** — the two runs'
+       normalized logs compare byte-identical, and non-trivially.
+    """
+    first = await _elastic_run(seed)
+    second = await _elastic_run(seed)
+    violations = list(first.pop("violations"))
+    violations.extend(second.pop("violations"))
+    log1 = first.pop("log_bytes")
+    log2 = second.pop("log_bytes")
+    if not log1:
+        violations.append("first run produced an empty "
+                          "decision/evacuation log")
+    if log1 != log2:
+        violations.append(
+            "same-seed decision/evacuation logs differ between runs")
+    return {
+        "seed": seed,
+        "runs": [first, second],
+        "log_sha256": first.get("log_sha256"),
+        "violations": violations,
+    }
+
+
 async def _stream_cursor_check(
     srv, sq: str, records: int, violations: list[str]
 ) -> dict:

@@ -23,6 +23,16 @@ log = logging.getLogger("chanamq.membership")
 ALIVE = "alive"
 DOWN = "down"
 
+# lifecycle states (gossiped independently of liveness): a node is born
+# JOINING, turns ACTIVE once it has exchanged a heartbeat with the cluster,
+# enters DRAINING when an operator starts an evacuation, and ends LEFT when
+# every held queue has moved off. DRAINING/LEFT nodes stay out of the
+# placement ring so no new holdership lands on them.
+JOINING = "joining"
+ACTIVE = "active"
+DRAINING = "draining"
+LEFT = "left"
+
 
 @dataclass
 class Member:
@@ -30,6 +40,10 @@ class Member:
     incarnation: int = 0
     status: str = ALIVE
     last_seen: float = field(default_factory=time.monotonic)
+    # lifecycle travels on its own monotonic version so it converges even
+    # when the incarnation counter (liveness suspicion) never moves
+    lifecycle: str = ACTIVE
+    lifecycle_version: int = 0
 
     @property
     def host(self) -> str:
@@ -64,8 +78,10 @@ class Membership:
         self.heartbeat_interval_s = heartbeat_interval_s
         self.failure_timeout_s = failure_timeout_s
         self.incarnation = int(time.time() * 1000)
+        lifecycle = JOINING if self.seeds else ACTIVE
         self.members: dict[str, Member] = {
-            self_name: Member(self_name, self.incarnation)
+            self_name: Member(self_name, self.incarnation,
+                              lifecycle=lifecycle)
         }
         self.listeners: list[MembershipListener] = []
         self._clients: dict[str, RpcClient] = {}
@@ -82,6 +98,28 @@ class Membership:
     def is_alive(self, name: str) -> bool:
         member = self.members.get(name)
         return member is not None and member.status == ALIVE
+
+    def lifecycle_of(self, name: str) -> str:
+        member = self.members.get(name)
+        return member.lifecycle if member is not None else ACTIVE
+
+    def placement_members(self) -> list[str]:
+        """Alive members eligible for NEW holdership: draining and left
+        nodes keep serving what they still hold but take nothing new."""
+        return [
+            name for name in self.alive_members()
+            if self.members[name].lifecycle not in (DRAINING, LEFT)
+        ]
+
+    def set_lifecycle(self, state: str) -> None:
+        """Advance this node's own lifecycle state (version bump makes the
+        transition win every gossip merge)."""
+        me = self.members[self.self_name]
+        if me.lifecycle == state:
+            return
+        me.lifecycle = state
+        me.lifecycle_version += 1
+        self._emit("lifecycle", me)
 
     def leader(self) -> str:
         """Deterministic leader: lowest alive name (the reference's
@@ -124,25 +162,48 @@ class Membership:
         return {
             "from": self.self_name,
             "members": {
-                name: {"incarnation": m.incarnation, "status": m.status}
+                name: {"incarnation": m.incarnation, "status": m.status,
+                       "lc": m.lifecycle, "lv": m.lifecycle_version}
                 for name, m in self.members.items()
             },
         }
+
+    def _merge_lifecycle(self, member: Member, info: dict) -> None:
+        lv = int(info.get("lv", 0))
+        if lv > member.lifecycle_version:
+            member.lifecycle_version = lv
+            state = str(info.get("lc", ACTIVE))
+            if state != member.lifecycle:
+                member.lifecycle = state
+                self._emit("lifecycle", member)
 
     def _merge(self, view: dict) -> None:
         for name, info in (view.get("members") or {}).items():
             incarnation = int(info.get("incarnation", 0))
             status = str(info.get("status", ALIVE))
             if name == self.self_name:
+                # a peer gossiping a higher-versioned lifecycle for US is
+                # stale third-party state (e.g. a drain from a previous
+                # identity): refute it with a yet-higher version
+                me = self.members[name]
+                lv = int(info.get("lv", 0))
+                if lv > me.lifecycle_version:
+                    if str(info.get("lc", ACTIVE)) == me.lifecycle:
+                        me.lifecycle_version = lv
+                    else:
+                        me.lifecycle_version = lv + 1
+                        self._emit("lifecycle", me)
                 continue
             member = self.members.get(name)
             if member is None:
                 member = Member(name, incarnation, status)
                 member.last_seen = time.monotonic() if status == ALIVE else 0.0
                 self.members[name] = member
+                self._merge_lifecycle(member, info)
                 if status == ALIVE:
                     self._emit("up", member)
                 continue
+            self._merge_lifecycle(member, info)
             if incarnation > member.incarnation:
                 member.incarnation = incarnation
                 if status == ALIVE and member.status != ALIVE:
@@ -183,6 +244,10 @@ class Membership:
                 member.status = ALIVE
                 self._emit("up", member)
             self._merge(reply)
+            me = self.members[self.self_name]
+            if me.lifecycle == JOINING:
+                # first confirmed contact with the cluster: we're in
+                self.set_lifecycle(ACTIVE)
         except (RpcError, OSError, asyncio.TimeoutError):
             if (member.status == ALIVE
                     and time.monotonic() - member.last_seen > self.failure_timeout_s):

@@ -98,6 +98,10 @@ class ClusterNode:
         call_timeout_s: float = 10.0,
         uds_path: Optional[str] = None,
         uds_map: Optional[dict[str, str]] = None,
+        drain_retry_limit: int = 5,
+        drain_backoff_ms: int = 100,
+        drain_backoff_cap_ms: int = 2000,
+        drain_budget_s: float = 30.0,
     ) -> None:
         self.broker = broker
         self.rpc = RpcServer(host, port, uds_path=uds_path)
@@ -155,6 +159,14 @@ class ClusterNode:
                 batch_max=replicate_batch_max,
                 ack_timeout_ms=replicate_ack_timeout_ms)
             if replicate_factor > 1 else None)
+        # graceful drain / decommission (chana.mq.lifecycle.*)
+        from .lifecycle import LifecycleCoordinator
+
+        self.lifecycle = LifecycleCoordinator(
+            self, retry_limit=drain_retry_limit,
+            backoff_ms=drain_backoff_ms,
+            backoff_cap_ms=drain_backoff_cap_ms,
+            budget_s=drain_budget_s)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -174,7 +186,7 @@ class ClusterNode:
             uds_map=self.uds_map)
         self.membership.listeners.append(self._on_membership_event)
         await self.membership.start()
-        self.ring.set_nodes(self.membership.alive_members())
+        self.ring.set_nodes(self._ring_members())
         # pull metadata snapshot from the first reachable seed
         for seed in self._seeds:
             try:
@@ -204,6 +216,13 @@ class ClusterNode:
             self._anti_entropy_loop())
 
     async def stop(self) -> None:
+        if self.lifecycle._task is not None and \
+                not self.lifecycle._task.done():
+            self.lifecycle._task.cancel()
+            try:
+                await self.lifecycle._task
+            except (asyncio.CancelledError, Exception):
+                pass
         if self._anti_entropy_task is not None:
             self._anti_entropy_task.cancel()
             try:
@@ -349,31 +368,58 @@ class ClusterNode:
         # registering a live local queue claims holdership: ops for it must
         # come to this node while it serves consumers/messages
         self.broker.invalidate_routes()
+        prev = self.queue_metas.get((queue.vhost, queue.name))
         self.queue_metas[(queue.vhost, queue.name)] = {
             "durable": queue.durable,
             "auto_delete": queue.auto_delete,
             "ttl_ms": queue.ttl_ms,
             "arguments": dict(queue.arguments or {}),
             "holder": self.name,
+            # the fencing epoch survives re-registration: it only moves
+            # forward, through _set_holder
+            "epoch": int(prev.get("epoch") or 0) if prev is not None else 0,
         }
 
+    def queue_epoch(self, vhost: str, name: str) -> int:
+        meta = self.queue_metas.get((vhost, name))
+        return int(meta.get("epoch") or 0) if meta is not None else 0
+
+    def seat_epoch(self, vhost: str, name: str) -> int:
+        """Seat a freshly declared queue at fencing epoch 1. Epoch 0 marks
+        pre-fencing legacy traffic that the refusal checks deliberately
+        wave through, so a declared queue must start above it for its very
+        first ships to be fenceable. Re-declares keep the current epoch."""
+        meta = self.queue_metas.get((vhost, name))
+        if meta is None:
+            return 0
+        if not int(meta.get("epoch") or 0):
+            meta["epoch"] = 1
+        return int(meta["epoch"])
+
     def _set_holder(self, vhost: str, name: str, holder: Optional[str],
-                    decision: Optional[str] = None) -> None:
+                    decision: Optional[str] = None) -> int:
         """Record + replicate who serves a queue (None = released: the
-        hash ring decides again). A control-plane rebalance stamps its
-        decision id on the broadcast so every node's log links the move
-        back to the decision (and its recorded inputs)."""
+        hash ring decides again). Every holder change bumps the queue's
+        monotonic FENCING EPOCH and stamps it on the broadcast: receivers
+        (and replication ships) refuse anything carrying a lower epoch, so
+        a partitioned ex-holder cannot reassert a queue that moved on
+        without it. A control-plane rebalance stamps its decision id on
+        the broadcast so every node's log links the move back to the
+        decision (and its recorded inputs)."""
         self.broker.invalidate_routes()
         meta = self.queue_metas.get((vhost, name))
+        epoch = (int(meta.get("epoch") or 0) if meta is not None else 0) + 1
         if meta is not None:
             meta["holder"] = holder
+            meta["epoch"] = epoch
         payload = {
             "kind": "queue.holder", "vhost": vhost, "name": name,
-            "holder": holder,
+            "holder": holder, "epoch": epoch,
         }
         if decision is not None:
             payload["decision"] = decision
         self.broadcast_bg("meta.apply", payload)
+        return epoch
 
     def claim_queue(self, queue: "Queue") -> None:
         """Called by the broker when a queue materializes locally
@@ -414,6 +460,16 @@ class ClusterNode:
                 not queue.durable
                 or any(not qm.message.persisted for qm in queue.messages)):
             return False  # transient content would not survive the move
+        if self.replication is not None and queue.durable \
+                and not queue.is_stream:
+            # private-store deployments: the target must hold a complete,
+            # head-synced replica copy BEFORE holdership moves — it
+            # materializes that copy when it activates. (Shared-store
+            # deployments pass through here too; the copy just duplicates
+            # rows the target could already see.)
+            if not await self.replication.prepare_handoff(
+                    vhost_name, name, target):
+                return False
         # detach remote-consumer stubs; their origins re-register on the
         # new holder when the queue.holder broadcast lands
         for consumer in list(queue.consumers):
@@ -435,14 +491,34 @@ class ClusterNode:
         if any(key[0] == vhost_name and key[1] == name
                for key in self._remote_consumers):
             asyncio.get_event_loop().create_task(self._reconcile_consumers())
-        try:
-            await self._call(target, "queue.activate",
-                             {"vhost": vhost_name, "name": name})
-        except (RpcError, OSError) as exc:
-            # holdership already points at the target: it will activate
-            # lazily on the first proxied op instead
-            log.warning("%s: handoff activate on %s failed (%s); "
-                        "target will lazy-activate", self.name, target, exc)
+        activated = False
+        delay = 0.05
+        for attempt in range(3):
+            try:
+                await self._call(target, "queue.activate",
+                                 {"vhost": vhost_name, "name": name,
+                                  "handoff": True})
+                activated = True
+                break
+            except (RpcError, OSError) as exc:
+                log.warning("%s: handoff activate of %s/%s on %s failed "
+                            "(attempt %d: %s)", self.name, vhost_name, name,
+                            target, attempt + 1, exc)
+                self.broker.metrics.lifecycle_evacuation_retries += 1
+                if self.membership is None \
+                        or not self.membership.is_alive(target):
+                    break  # target died: no point retrying it
+                await asyncio.sleep(delay)
+                delay *= 2
+        if not activated:
+            # roll holdership back: the store rows were never unreferred,
+            # so re-activating locally rematerializes the full backlog and
+            # re-claims with a FRESH epoch (so the aborted target claim
+            # can't win a late race)
+            self.broker.metrics.lifecycle_rollbacks += 1
+            log.warning("%s: rolling %s/%s holdership back from %s",
+                        self.name, vhost_name, name, target)
+            await self.broker.activate_queue(vhost_name, name)
             return False
         log.info("%s: handed off %s/%s -> %s%s", self.name, vhost_name,
                  name, target,
@@ -453,10 +529,45 @@ class ClusterNode:
     # membership reactions
     # ------------------------------------------------------------------
 
+    @property
+    def draining(self) -> bool:
+        """True once this node entered DRAINING (or finished, LEFT): it
+        keeps serving what it still holds but claims nothing new."""
+        if self.membership is None:
+            return False
+        from .membership import DRAINING, LEFT
+
+        me = self.membership.members.get(self.name)
+        return me is not None and me.lifecycle in (DRAINING, LEFT)
+
+    def _ring_members(self) -> list[str]:
+        """Placement-eligible members for the ownership ring: draining and
+        left nodes are excluded so no new holdership hashes onto them. If
+        that empties the ring (every node draining), fall back to the full
+        alive set — refusing all placement is worse than placing badly."""
+        assert self.membership is not None
+        placement = self.membership.placement_members()
+        return placement or self.membership.alive_members()
+
     def _on_membership_event(self, event: str, member: Member) -> None:
         assert self.membership is not None
         self.broker.invalidate_routes()
-        self.ring.set_nodes(self.membership.alive_members())
+        self.ring.set_nodes(self._ring_members())
+        if event == "lifecycle":
+            from .membership import LEFT
+
+            if member.lifecycle == LEFT and member.name != self.name:
+                # the member finished draining: any holdership still
+                # pointing at it is a straggler the evacuation broadcasts
+                # missed — clear it so the ring decides again
+                for meta in self.queue_metas.values():
+                    if meta.get("holder") == member.name:
+                        meta["holder"] = None
+                        self.broker.metrics.lifecycle_stale_holders_cleared \
+                            += 1
+            self._deactivate_unowned()
+            asyncio.get_event_loop().create_task(self._reconcile_consumers())
+            return
         if event == "down":
             # tear down the dead peer's data streams: buffered batches fail
             # fast instead of dialing a corpse until their timeouts
@@ -736,11 +847,39 @@ class ClusterNode:
         bindings this node has never heard of. Existing local entries are
         never overwritten — local state may be newer (fresher holders,
         post-promotion metas) than the peer's."""
+        from .membership import DOWN, LEFT
+
         merged = 0
         for key, meta in (snapshot.get("queues") or {}).items():
             vhost, _, name = key.partition("\x00")
-            if (vhost, name) not in self.queue_metas:
+            local = self.queue_metas.get((vhost, name))
+            if local is None:
                 self.queue_metas[(vhost, name)] = dict(meta)
+                merged += 1
+                continue
+            # holder reconciliation (NOT add-only): adopt the peer's
+            # holdership when it carries a strictly newer fencing epoch —
+            # a drain that completed while this node was partitioned left
+            # it with a stale holder that plain gap-fill would resurrect
+            incoming = int(meta.get("epoch") or 0)
+            current = int(local.get("epoch") or 0)
+            if incoming > current:
+                local["epoch"] = incoming
+                if local.get("holder") != meta.get("holder"):
+                    local["holder"] = meta.get("holder")
+                    merged += 1
+        # clear holderships pointing at members this node knows are gone
+        # (left the cluster, or dead): nobody can serve them, and keeping
+        # them pins proxied ops onto a corpse until the next down event
+        for (vhost, name), local in self.queue_metas.items():
+            holder = local.get("holder")
+            if not holder or holder == self.name or self.membership is None:
+                continue
+            member = self.membership.members.get(holder)
+            if member is not None and (member.status == DOWN
+                                       or member.lifecycle == LEFT):
+                local["holder"] = None
+                self.broker.metrics.lifecycle_stale_holders_cleared += 1
                 merged += 1
         for ex in snapshot.get("exchanges") or []:
             vhost_name = str(ex.get("vhost", ""))
@@ -845,19 +984,39 @@ class ClusterNode:
                     payload.get("args") or None)
             return {}
         if kind == "queue.declared":
-            self.queue_metas[(vhost_name, str(payload["name"]))] = {
+            name = str(payload["name"])
+            prev = self.queue_metas.get((vhost_name, name))
+            # re-declares must not rewind the fencing epoch
+            epoch = max(int(payload.get("epoch") or 0),
+                        int(prev.get("epoch") or 0) if prev is not None else 0)
+            self.queue_metas[(vhost_name, name)] = {
                 "durable": bool(payload.get("durable")),
                 "auto_delete": bool(payload.get("auto_delete")),
                 "ttl_ms": payload.get("ttl_ms"),
                 "arguments": payload.get("arguments") or {},
                 "holder": payload.get("holder"),
+                "epoch": epoch,
             }
             return {}
         if kind == "queue.holder":
             name = str(payload["name"])
             meta = self.queue_metas.get((vhost_name, name))
             if meta is not None:
+                incoming = int(payload.get("epoch") or 0)
+                current = int(meta.get("epoch") or 0)
+                if incoming and incoming < current:
+                    # fenced: a stale (pre-move) holder broadcast arriving
+                    # late — e.g. from a partitioned ex-owner healing —
+                    # must not overwrite the newer holdership
+                    self.broker.metrics.lifecycle_stale_epoch_refused += 1
+                    log.warning(
+                        "%s: refused stale holder broadcast for %s/%s "
+                        "(epoch %d < %d)", self.name, vhost_name, name,
+                        incoming, current)
+                    return {"refused": True}
                 meta["holder"] = payload.get("holder")
+                if incoming:
+                    meta["epoch"] = incoming
             decision = payload.get("decision")
             if decision:
                 # a proactive control-plane move, not a failure/ring event
@@ -931,8 +1090,19 @@ class ClusterNode:
                 "consumer_count": queue.consumer_count}
 
     async def _h_queue_activate(self, payload: dict) -> dict:
-        queue = await self.broker.activate_queue(
-            str(payload["vhost"]), str(payload["name"]))
+        vhost = str(payload["vhost"])
+        name = str(payload["name"])
+        if self.draining and self.broker.vhosts.get(vhost) is not None \
+                and name not in self.broker.vhosts[vhost].queues:
+            # a draining node takes no NEW holdership: refuse the cold
+            # activation so the caller re-resolves against the ring
+            raise RpcError("draining", f"{self.name} is draining")
+        if payload.get("handoff") and self.replication is not None:
+            # graceful handoff: the source synced our replica copy to its
+            # log head before moving holdership — materialize it (private
+            # stores have no other path to the message bodies)
+            await self.replication.materialize_copy(vhost, name)
+        queue = await self.broker.activate_queue(vhost, name)
         return {"active": queue is not None}
 
     async def _h_queue_delete(self, payload: dict) -> dict:
@@ -951,12 +1121,30 @@ class ClusterNode:
         return {"message_count": queue.message_count,
                 "consumer_count": queue.consumer_count}
 
+    def _push_fenced(self, vhost: str, name: str) -> bool:
+        """True when a push for this queue must be refused: this node is
+        draining/left and the replicated meta says someone else holds the
+        queue — accepting the write would re-claim a queue the drain just
+        evacuated (the split-brain the fencing epochs exist to prevent)."""
+        if not self.draining:
+            return False
+        meta = self.queue_metas.get((vhost, name))
+        if meta is None:
+            return True  # unknown queue: a drainer takes nothing new
+        holder = meta.get("holder")
+        if holder == self.name:
+            return False  # still ours (drain hasn't reached it yet)
+        self.broker.metrics.lifecycle_stale_epoch_refused += 1
+        return True
+
     async def _resolve_push_queues(
         self, vhost: str, queue_names: list[str], body_len: int
     ) -> tuple[list, bool]:
         queues = []
         had_consumer = False
         for name in queue_names:
+            if self._push_fenced(vhost, name):
+                continue
             queue = await self.broker.activate_queue(vhost, name)
             if queue is not None:
                 queues.append(queue)
@@ -1074,6 +1262,8 @@ class ClusterNode:
             ridx += 1
             queues = []
             for name in names:
+                if self._push_fenced(vhost, name):
+                    continue
                 queue = rcache.get((vhost, name))
                 if queue is None:
                     # slow path activates from the store; misses (unknown

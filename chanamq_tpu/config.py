@@ -270,6 +270,15 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.replicate.sync": False,
     "chana.mq.replicate.batch-max": 256,   # events per shipped batch
     "chana.mq.replicate.ack-timeout-ms": 1000,
+    # node lifecycle (cluster/lifecycle.py): graceful drain / decommission.
+    # A draining node stops taking new holdership, evacuates every held
+    # queue via handoff with bounded retry, then gossips `left`.
+    "chana.mq.lifecycle.drain-retry-limit": 5,
+    "chana.mq.lifecycle.drain-backoff": "100ms",      # first retry delay
+    "chana.mq.lifecycle.drain-backoff-cap": "2s",     # retry delay ceiling
+    # evacuation budget: past this the drain-stuck alert fires (the drain
+    # itself keeps retrying as long as any pass still makes progress)
+    "chana.mq.lifecycle.drain-budget": "30s",
     # stream queues (streams/): append-only segmented logs declared with
     # x-queue-type=stream. The active in-memory segment seals and spills
     # to the store at segment-bytes or segment-age, whichever first

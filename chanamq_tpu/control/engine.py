@@ -96,6 +96,10 @@ class ControlInputs:
     self_load: float = 0.0          # inflow EWMA, bytes/s
     peer_loads: dict = field(default_factory=dict)
     consume_credit: Optional[int] = None
+    # a member that just joined (set by the service for a bounded window):
+    # backlog should drain onto it even when this node's load does not
+    # diverge from the cluster mean yet
+    join_target: Optional[str] = None
 
 
 def _r(value: float) -> float:
@@ -217,6 +221,32 @@ class ControlEngine:
         loads = dict(inp.peer_loads)
         loads[inp.node] = inp.self_load
         mean = sum(loads.values()) / len(loads)
+        join = inp.join_target
+        if join is not None and join in inp.peer_loads:
+            # join-triggered rebalance: a fresh member carries nothing, so
+            # the divergence gate would sit silent until this node is
+            # already hot — seed the joiner with the busiest movable queue
+            # immediately (cooldown still applies; the service bounds the
+            # window)
+            if not self._cooled("rebalance", inp.tick,
+                                cfg.rebalance_cooldown_ticks):
+                return 1
+            movable = [q for q in inp.queues if q.movable]
+            if not movable:
+                return 1
+            queue = max(movable,
+                        key=lambda q: (q.publish_rate + q.deliver_rate,
+                                       q.vhost, q.name))
+            self._emit(decisions, inp, "rebalance.move",
+                       {"vhost": queue.vhost, "name": queue.name,
+                        "target": join, "join": True},
+                       {"self_load": _r(inp.self_load),
+                        "mean_load": _r(mean),
+                        "queue_rate": _r(queue.publish_rate
+                                         + queue.deliver_rate),
+                        "loads": {n: _r(v) for n, v in sorted(loads.items())}})
+            self._reb_streak = 0
+            return 0
         if mean < cfg.rebalance_min_rate or \
                 inp.self_load <= cfg.rebalance_ratio * mean:
             self._reb_streak = 0

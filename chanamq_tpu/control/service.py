@@ -64,6 +64,7 @@ class ControlService:
         log_size: int = 256,
         forecast_max_age_s: float = 10.0,
         forecast_error_gate: float = 0.5,
+        join_window_s: float = 30.0,
     ) -> None:
         self.broker = broker
         self.interval_s = max(0.05, float(interval_s))
@@ -97,6 +98,12 @@ class ControlService:
         self._last_published_bytes: Optional[int] = None
         # original publish credit, saved at pre-arm so relax restores it
         self._orig_credit: Optional[int] = None
+        # join-triggered rebalance: a member that came up recently is fed
+        # to the engine as an explicit target for a bounded tick window
+        self._join_window_ticks = ticks(join_window_s)
+        self._join_target: Optional[str] = None
+        self._join_deadline_tick = 0
+        self._member_listener = None
         self._task: Optional[asyncio.Task] = None
         self._stopping = False
         self._executor = ThreadPoolExecutor(
@@ -106,12 +113,39 @@ class ControlService:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> None:
+        cluster = self.broker.cluster
+        if cluster is not None and cluster.membership is not None:
+
+            def _on_member(event: str, member) -> None:
+                if event == "up" and member.name != cluster.name:
+                    self.note_member_join(member.name)
+
+            self._member_listener = _on_member
+            cluster.membership.listeners.append(_on_member)
         self._task = asyncio.get_event_loop().create_task(self._run())
         log.info("control plane started (interval=%.2fs dry_run=%s)",
                  self.interval_s, self.dry_run)
 
+    def note_member_join(self, name: str) -> None:
+        """A member joined: make it a rebalance target for a bounded
+        window so backlog drains onto it without waiting for this node's
+        load to diverge. Joins observed before the first tick are boot
+        convergence, not elasticity — ignored."""
+        if not self.rebalance_enabled or self.tick < 1:
+            return
+        self._join_target = name
+        self._join_deadline_tick = self.tick + self._join_window_ticks
+
     async def stop(self) -> None:
         self._stopping = True
+        cluster = self.broker.cluster
+        if self._member_listener is not None and cluster is not None \
+                and cluster.membership is not None:
+            try:
+                cluster.membership.listeners.remove(self._member_listener)
+            except ValueError:
+                pass
+            self._member_listener = None
         if self._task is not None:
             self._task.cancel()
             try:
@@ -201,6 +235,17 @@ class ControlService:
         consume_credit = None
         if self.prefetch_enabled and cluster is not None:
             consume_credit = cluster.consume_credit
+        join_target = None
+        if self._join_target is not None:
+            membership = cluster.membership if cluster is not None else None
+            expired = self.tick > self._join_deadline_tick
+            gone = (membership is None
+                    or self._join_target not in
+                    membership.placement_members())
+            if expired or gone:
+                self._join_target = None
+            else:
+                join_target = self._join_target
         inputs = ControlInputs(
             tick=self.tick,
             interval_s=self.interval_s,
@@ -217,6 +262,7 @@ class ControlService:
             node=broker.trace_node,
             self_load=self.load_rate,
             consume_credit=consume_credit,
+            join_target=join_target,
         )
         return inputs
 
@@ -369,9 +415,15 @@ class ControlService:
             cluster = broker.cluster
             if cluster is None or not self.rebalance_enabled:
                 return False
-            return await cluster.handoff_queue(
+            moved = await cluster.handoff_queue(
                 str(action["vhost"]), str(action["name"]),
                 str(action["target"]), decision=decision["id"])
+            if action.get("join"):
+                # one seeding move per observed join
+                self._join_target = None
+                if moved:
+                    broker.metrics.lifecycle_join_rebalances += 1
+            return moved
         if kind == "prefetch.tune":
             cluster = broker.cluster
             if cluster is None or not self.prefetch_enabled:

@@ -79,11 +79,44 @@ class ReplicaApplier:
     # RPC entry point
     # ------------------------------------------------------------------
 
+    async def h_probe(self, payload: dict) -> dict:
+        """Sync probe for the graceful-handoff gate: report how far this
+        node's copy of a queue has applied (−1: no copy for that owner)."""
+        copy = self.copies.get((str(payload["vhost"]),
+                                str(payload["queue"])))
+        if copy is None or copy.owner != str(payload.get("owner") or ""):
+            return {"applied": -1}
+        return {"applied": copy.applied_seq, "resyncing": copy.resyncing}
+
+    async def h_retire(self, payload: dict) -> dict:
+        """The owner dropped this node from a queue's follower set (ring
+        reshuffle on join/leave): discard the copy. It would never see
+        another ship, so keeping it is not redundancy — it is a stale
+        ack map waiting to split a future failover election."""
+        key = (str(payload["vhost"]), str(payload["queue"]))
+        copy = self.copies.get(key)
+        if copy is None or copy.owner != str(payload.get("owner") or ""):
+            return {"retired": False}
+        self._discard(copy)
+        return {"retired": True}
+
     async def h_append(self, payload: dict) -> dict:
         vhost = str(payload["vhost"])
         name = str(payload["queue"])
         owner = str(payload["owner"])
         key = (vhost, name)
+        epoch = int(payload.get("epoch") or 0)
+        node = self.manager.node
+        known = node.queue_epoch(vhost, name)
+        if epoch and known > epoch:
+            # fenced: the shipper lost holdership (drain/handoff bumped the
+            # epoch) but doesn't know yet — a partitioned ex-owner must not
+            # graft its stale history onto the copy of the queue's new life
+            node.broker.metrics.lifecycle_stale_epoch_refused += 1
+            log.warning("%s: refused stale-epoch ship of %s/%s from %s "
+                        "(epoch %d < %d)", node.name, vhost, name, owner,
+                        epoch, known)
+            return {"applied": 0, "refused": True}
         copy = self.copies.get(key)
         if copy is not None and copy.owner != owner:
             # the queue moved (promotion elsewhere, or a delete+redeclare

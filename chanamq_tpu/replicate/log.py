@@ -130,6 +130,8 @@ class ReplicationManager:
         node.rpc.register("repl.resync", self._h_resync)
         node.rpc.register("repl.rows", self._h_rows)
         node.rpc.register("repl.fetch", self._h_fetch)
+        node.rpc.register("repl.probe", self.applier.h_probe)
+        node.rpc.register("repl.retire", self.applier.h_retire)
 
     @property
     def metrics(self):
@@ -215,6 +217,9 @@ class ReplicationManager:
                     "owner": self.node.name, "base": batch[0]["s"],
                     "events": batch,
                     "acks": dict(repl.followers),
+                    # fencing: followers refuse batches stamped with an
+                    # epoch older than the holdership they know about
+                    "epoch": self.node.queue_epoch(repl.vhost, repl.name),
                 }
                 await asyncio.gather(*(
                     self._ship_one(repl, follower, payload)
@@ -275,17 +280,109 @@ class ReplicationManager:
                     pass
 
     # ------------------------------------------------------------------
+    # graceful handoff (drain / rebalance)
+    # ------------------------------------------------------------------
+
+    async def prepare_handoff(
+        self, vhost: str, name: str, target: str,
+        timeout_s: Optional[float] = None,
+    ) -> bool:
+        """Gate a graceful holdership move: make sure ``target`` holds a
+        replica copy synced to this log's head before anything moves.
+        Adds the target as a follower if the ring didn't already pick it
+        (a join target, or the only node left standing), nudges it with a
+        meta event (backlog > 0 makes a fresh follower resync wholesale
+        from this node's store), then polls its applied seq up to the
+        head. Nothing here is destructive — a timeout just refuses the
+        handoff and the queue stays where it is."""
+        from ..cluster.rpc import RpcError
+
+        key = (vhost, name)
+        vh = self.broker.vhosts.get(vhost)
+        queue = vh.queues.get(name) if vh is not None else None
+        if queue is None:
+            return False
+        repl = self._logs.get(key)
+        if repl is None:
+            # a previous aborted handoff may have closed the log: reattach
+            self.attach(queue)
+            repl = self._logs.get(key)
+            if repl is None:
+                return False
+        queue.flush_store_buffers()
+        if target not in repl.followers:
+            repl.followers[target] = 0
+        self._meta_event(repl, queue)
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + (
+            timeout_s if timeout_s is not None
+            else max(5.0, self.ack_timeout_s * 5))
+        while repl.followers.get(target, 0) < repl.seq:
+            if loop.time() >= deadline:
+                log.warning(
+                    "%s: handoff prepare of %s/%s -> %s timed out "
+                    "(acked %d < head %d)", self.node.name, vhost, name,
+                    target, repl.followers.get(target, 0), repl.seq)
+                return False
+            await asyncio.sleep(0.03)
+            try:
+                reply = await self.client_for(target).call(
+                    "repl.probe",
+                    {"vhost": vhost, "queue": name,
+                     "owner": self.node.name},
+                    timeout_s=self.ack_timeout_s)
+                applied = int(reply.get("applied", -1))
+                if applied > repl.followers.get(target, 0):
+                    repl.followers[target] = applied
+            except (RpcError, OSError, asyncio.TimeoutError):
+                pass  # transient; the deadline bounds us
+        return True
+
+    async def materialize_copy(self, vhost: str, name: str) -> bool:
+        """Graceful-handoff twin of the death promotion: turn this node's
+        replica copy into the live queue. No election — the source
+        coordinated the move and synced our copy to its head first. No-op
+        without a copy (shared-store deployments activate from the store
+        instead)."""
+        key = (vhost, name)
+        fut = self._promoting.get(key)
+        if fut is not None:
+            await fut
+            return True
+        copy = self.applier.copies.get(key)
+        if copy is None:
+            return False
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        self._promoting[key] = fut
+        await self._promote(key, copy, fut, reason="handoff")
+        return True
+
+    # ------------------------------------------------------------------
     # membership reactions + promotion
     # ------------------------------------------------------------------
 
     def on_membership(self) -> None:
         """Recompute follower sets from the (already updated) ring. Retained
         followers keep their ack state; new ones start at 0 and resync on
-        the first batch they see (gap or meta-backlog detection)."""
+        the first batch they see (gap or meta-backlog detection). Dropped
+        followers are told to discard their copies: a copy that will never
+        see another ship is not a safety net but a split-election seed —
+        were the owner to die later, the dropped follower and the current
+        one would each elect themselves from disjoint ack maps. Best-effort
+        (a partitioned ex-follower keeps its copy; the dual-holder
+        reconcile mops up that corner)."""
+        membership = self.node.membership
         for repl in self._logs.values():
             wanted = self._select_followers(repl.vhost, repl.name)
             fresh = [n for n in wanted if n not in repl.followers]
+            dropped = [n for n in repl.followers if n not in wanted]
             repl.followers = {n: repl.followers.get(n, 0) for n in wanted}
+            for name in dropped:
+                if membership is None or not membership.is_alive(name):
+                    continue
+                asyncio.get_event_loop().create_task(
+                    self._retire_one(name, repl.vhost, repl.name))
             if fresh:
                 vh = self.broker.vhosts.get(repl.vhost)
                 queue = vh.queues.get(repl.name) if vh is not None else None
@@ -296,6 +393,17 @@ class ReplicationManager:
             if repl.pending:
                 self._ship_soon(repl)
 
+    async def _retire_one(self, follower: str, vhost: str, name: str) -> None:
+        from ..cluster.rpc import RpcError
+
+        try:
+            await self.client_for(follower).call(
+                "repl.retire",
+                {"vhost": vhost, "queue": name, "owner": self.node.name},
+                timeout_s=self.ack_timeout_s)
+        except (RpcError, OSError, asyncio.TimeoutError):
+            pass  # best-effort; the dual-holder reconcile covers the miss
+
     def on_node_down(self, dead: str) -> None:
         """Owner side: re-pick followers. Follower side: elect a promotion
         winner for every copy whose owner just died. The election is
@@ -303,17 +411,39 @@ class ReplicationManager:
         the dead owner's last piggybacked ack map (each node's own applied
         seq is authoritative for itself) — so at most one surviving
         follower promotes."""
+        from ..cluster.membership import DRAINING, LEFT
+
         self.on_membership()
         me = self.node.name
         membership = self.node.membership
+
+        def electable(name: str) -> bool:
+            # draining/left nodes keep serving copies (they are handoff
+            # sources) but must never WIN a failover election: a
+            # decommissioned node re-claiming a queue would undo its own
+            # evacuation. Every voter applies the same lifecycle filter,
+            # so the election stays single-winner.
+            if membership is None:
+                return True
+            return membership.lifecycle_of(name) not in (DRAINING, LEFT)
+
         for key, copy in list(self.applier.copies.items()):
             if copy.owner != dead or key in self._promoting:
                 continue
-            contenders = {me: copy.applied_seq}
+            holder = (self.node.queue_metas.get(key) or {}).get("holder")
+            if (holder and holder != dead and membership is not None
+                    and membership.is_alive(holder)):
+                # the queue already moved on (evacuated or promoted while
+                # this copy idled): electing from the relic would steal
+                # holdership back from the live owner with a fresher epoch
+                continue
+            contenders = {me: copy.applied_seq} if electable(me) else {}
             for name, acked in (copy.peer_acks or {}).items():
                 if (name != me and name != dead and membership is not None
-                        and membership.is_alive(name)):
+                        and membership.is_alive(name) and electable(name)):
                     contenders[name] = int(acked)
+            if not contenders:
+                continue
             winner = max(contenders.items(), key=lambda kv: (kv[1], kv[0]))[0]
             if winner != me:
                 continue
@@ -331,7 +461,8 @@ class ReplicationManager:
             await fut
 
     async def _promote(
-        self, key: tuple[str, str], copy, fut: asyncio.Future
+        self, key: tuple[str, str], copy, fut: asyncio.Future,
+        *, reason: str = "failover",
     ) -> None:
         vhost_name, name = key
         try:
@@ -360,12 +491,13 @@ class ReplicationManager:
             self.node.claim_queue(queue)
             self.attach(queue)
             self.applier.release_copy(key)
-            self.metrics.repl_promotions += 1
+            if reason == "failover":
+                self.metrics.repl_promotions += 1
             log.info(
-                "%s: promoted replica of %s/%s at seq %d "
-                "(%d ready, %d unacked requeued)",
+                "%s: promoted replica of %s/%s at seq %d (%s; "
+                "%d ready, %d unacked requeued)",
                 self.node.name, vhost_name, name, copy.applied_seq,
-                len(sq.msgs), len(sq.unacks))
+                reason, len(sq.msgs), len(sq.unacks))
         except Exception:
             log.exception("%s: promotion of %s/%s failed",
                           self.node.name, vhost_name, name)

@@ -138,7 +138,13 @@ class AdminServer:
             # unknown path: 404 regardless of verb
             return "404 Not Found", {"error": "unknown path"}
         allowed, handler = matched
-        if method != allowed:
+        if isinstance(allowed, dict):
+            # verb-dispatched path (GET /admin/drain observes, POST starts)
+            handler = allowed.get(method)
+            if handler is None:
+                return ("405 Method Not Allowed",
+                        {"error": f"use {' or '.join(sorted(allowed))}"})
+        elif method != allowed:
             # KNOWN path, wrong verb: 405 naming the verb that works —
             # never the blanket 404 that made a POSTed scrape or a GET
             # mutation attempt indistinguishable from a typo'd path
@@ -190,6 +196,9 @@ class AdminServer:
             return ("GET", self._streams)
         if rest == ["cluster"]:
             return ("GET", self._cluster)
+        if rest == ["drain"]:
+            return ({"POST": self._drain_start,
+                     "GET": self._drain_status}, None)
         if rest == ["replication"]:
             return ("GET", self._replication)
         if rest == ["forecast"]:
@@ -536,6 +545,10 @@ class AdminServer:
         "shard_cross_pushes", "shard_handoffs", "shard_restarts",
         "control_ticks", "control_decisions", "control_applied",
         "control_suppressed", "control_dry_run", "control_errors",
+        "lifecycle_drains_started", "lifecycle_queues_evacuated",
+        "lifecycle_evacuation_retries", "lifecycle_rollbacks",
+        "lifecycle_stale_epoch_refused", "lifecycle_join_rebalances",
+        "lifecycle_stale_holders_cleared",
     })
 
     @staticmethod
@@ -739,6 +752,23 @@ class AdminServer:
                 })
         return out
 
+    def _lifecycle(self):
+        cluster = self.broker.cluster
+        if cluster is None or cluster.membership is None:
+            raise AdminError(
+                "409 Conflict",
+                "clustering disabled: boot with chana.mq.cluster.enabled")
+        return cluster.lifecycle
+
+    def _drain_start(self) -> dict:
+        """Begin (idempotently) this node's graceful decommission: stop
+        taking new holdership, evacuate every held queue, gossip `left`.
+        Poll GET /admin/drain for progress."""
+        return self._lifecycle().drain()
+
+    def _drain_status(self) -> dict:
+        return self._lifecycle().progress()
+
     def _cluster(self) -> dict:
         """Cluster membership + queue ownership as the operator sees it
         (exceeds the reference, whose admin surface was vhost-only)."""
@@ -755,12 +785,21 @@ class AdminServer:
             "self": cluster.name,
             "members": {
                 name: {"status": member.status,
-                       "incarnation": member.incarnation}
+                       "incarnation": member.incarnation,
+                       "lifecycle": member.lifecycle}
                 for name, member in cluster.membership.members.items()
             },
             "alive": cluster.membership.alive_members(),
+            "placement": cluster.membership.placement_members(),
+            "drain": cluster.lifecycle.progress(),
             "known_queues": len(cluster.queue_metas),
             "owned_queues": owned,
+            # fencing epochs: bumped on every holdership change; stale-epoch
+            # metadata and replication ships are refused
+            "queue_epochs": {
+                f"{vhost}/{name}": int(meta.get("epoch") or 0)
+                for (vhost, name), meta in sorted(cluster.queue_metas.items())
+            },
             "shard": getattr(self.broker, "shard_info", None),
             "shard_siblings": dict(cluster.uds_map),
             "replication": (

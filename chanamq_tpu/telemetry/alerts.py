@@ -60,6 +60,7 @@ def default_rules(
     loop_lag_ms: float = 250.0,
     memory_stage: float = 3.5,
     control_floor_ticks: int = 300,
+    drain_stuck_ticks: int = 2,
 ) -> list[AlertRule]:
     """The built-in rules, thresholds from chana.mq.alerts.*.
 
@@ -93,6 +94,13 @@ def default_rules(
             name="control-prearm-stuck", scope="node",
             metric="control_floor", threshold=0.5,
             for_ticks=max(1, control_floor_ticks), severity="warning"),
+        # a graceful drain past its evacuation budget: queues are pinned
+        # (streams, local consumers) or every handoff attempt is failing —
+        # the node will sit in `draining` forever without intervention
+        AlertRule(
+            name="drain-stuck", scope="node", metric="drain_overdue",
+            threshold=0.5, for_ticks=max(1, drain_stuck_ticks),
+            severity="critical"),
     ]
 
 

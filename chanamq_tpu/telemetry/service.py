@@ -258,6 +258,12 @@ class TelemetryService:
             # control-prearm-stuck rule watches for a floor that never
             # relaxes (forecast stuck pessimistic / relax path broken)
             "control_floor": float(flow.floor) if flow is not None else 0.0,
+            # 1.0 while a graceful drain has blown its evacuation budget
+            # (queues stuck pinned/failing) — the drain-stuck rule fires on
+            # it so an operator knows the decommission needs a hand
+            "drain_overdue": (
+                cluster.lifecycle.drain_overdue()
+                if cluster is not None else 0.0),
         }
 
     def _evaluate_alerts(self, probes: dict[str, float]) -> None:

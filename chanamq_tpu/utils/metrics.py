@@ -186,6 +186,19 @@ class Metrics:
         self.control_dry_run = 0
         self.control_errors = 0
         self.chaos_pressure = 0
+        # node lifecycle (chanamq_tpu/cluster/lifecycle.py): drains run on
+        # this node, queues it evacuated, activate retries + holdership
+        # rollbacks during evacuation, fencing-epoch refusals (stale
+        # broadcasts, ships, and writes), join-triggered rebalances this
+        # node's control plane emitted, and stale holderships cleared by
+        # anti-entropy / lifecycle events.
+        self.lifecycle_drains_started = 0
+        self.lifecycle_queues_evacuated = 0
+        self.lifecycle_evacuation_retries = 0
+        self.lifecycle_rollbacks = 0
+        self.lifecycle_stale_epoch_refused = 0
+        self.lifecycle_join_rebalances = 0
+        self.lifecycle_stale_holders_cleared = 0
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -318,6 +331,14 @@ class Metrics:
             "wal_commit_mean_us": self.wal_commit_us.mean_us,
             "alerts_fired": self.alerts_fired,
             "alerts_resolved": self.alerts_resolved,
+            "lifecycle_drains_started": self.lifecycle_drains_started,
+            "lifecycle_queues_evacuated": self.lifecycle_queues_evacuated,
+            "lifecycle_evacuation_retries": self.lifecycle_evacuation_retries,
+            "lifecycle_rollbacks": self.lifecycle_rollbacks,
+            "lifecycle_stale_epoch_refused": self.lifecycle_stale_epoch_refused,
+            "lifecycle_join_rebalances": self.lifecycle_join_rebalances,
+            "lifecycle_stale_holders_cleared":
+                self.lifecycle_stale_holders_cleared,
         }
         for key, hist in self.trace_stage_us.items():
             base = key[:-3] if key.endswith("_us") else key

@@ -58,6 +58,22 @@ grep -q '"under_hard_limit": true' /tmp/_t1_overload.json || {
     exit 1
 }
 
+echo "tier1: elasticity soak smoke (~30 s: join, drain, kill -9, fenced stale owner, x2 runs)"
+# the soak itself fails (violation -> exit 1) on confirmed loss, dual
+# holders at quiesce, an unfenced stale-epoch ship, a non-contiguous
+# stream resume, or same-seed runs whose normalized decision/evacuation
+# logs differ; the grep double-checks at least one stale ship was refused
+timeout -k 10 300 python bench.py --elastic --seed 11 \
+        | tee /tmp/_t1_elastic.json || {
+    rc=$?
+    echo "tier1: elasticity soak smoke FAILED (rc=$rc) — lifecycle invariant violation" >&2
+    exit "$rc"
+}
+grep -q '"stale_epoch_refused": [1-9]' /tmp/_t1_elastic.json || {
+    echo "tier1: elasticity soak never refused a stale-epoch ship" >&2
+    exit 1
+}
+
 echo "tier1: control soak smoke (~10 s: pre-armed vs reactive spike, x4 runs)"
 # the soak itself fails (violation -> exit 1) unless the pre-armed run
 # beats the reactive ladder (strictly lower max stage, strictly fewer

@@ -89,6 +89,10 @@ class FakeNode:
         self.rpc = FakeRpc()
         self.ring = HashRing(list(alive), 8)
         self.membership = FakeMembership(alive)
+        self.epochs = {}
+
+    def queue_epoch(self, vhost, name):
+        return self.epochs.get((vhost, name), 0)
 
 
 def make_manager(**kw):

@@ -313,7 +313,7 @@ async def test_admin_telemetry_get_and_405(telemetry_stack):
     status, body = await http_req(admin.bound_port, "/admin/alerts")
     assert [r["name"] for r in body["rules"]] == [
         "backlog-growth", "consumer-stall", "replication-lag", "loop-lag",
-        "memory-pressure", "control-prearm-stuck"]
+        "memory-pressure", "control-prearm-stuck", "drain-stuck"]
     assert body["firing"] == []
 
 
