@@ -1165,6 +1165,192 @@ def run_stream_spec() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+# ---------------------------------------------------------------------------
+# --route: tensorized router microbench (compile + batch-route vs trie)
+# ---------------------------------------------------------------------------
+
+def _route_build_matcher(n: int):
+    """Binding corpus at size n: exact-heavy (the shape compiled into the
+    host dict) with a capped wildcard tail (the shape the kernel handles),
+    mirroring a direct/topic production mix."""
+    from chanamq_tpu.broker.matchers import TopicMatcher
+
+    m = TopicMatcher()
+    n_wild = min(256, max(16, n // 100))
+    for i in range(n - n_wild):
+        m.bind(f"t{i % 97}.k{i}.s{i % 31}", f"q{i % 512}")
+    for i in range(n_wild):
+        pattern = (f"t{i % 97}.*.s{i % 31}" if i % 2
+                   else f"w{i % 97}.k{i}.#")
+        m.bind(pattern, f"wq{i % 64}")
+    return m
+
+
+def _route_keys(n: int, msgs: int, rng) -> list:
+    """Message corpus: drawn from a bounded pool of active routing keys
+    (pub/sub traffic reuses keys heavily — topics are stable, messages
+    are not), pool mix ~70% exact hits, ~15% wildcard-shaped, ~15%
+    misses."""
+    pool = []
+    pool_size = min(max(msgs // 8, 256), 2048)
+    for _ in range(pool_size):
+        r = rng.random()
+        if r < 0.70:
+            i = rng.randrange(n)
+            pool.append(f"t{i % 97}.k{i}.s{i % 31}")
+        elif r < 0.85:
+            i = rng.randrange(max(1, n // 100))
+            pool.append(f"t{i % 97}.x{rng.randrange(1000)}.s{i % 31}")
+        else:
+            pool.append(f"miss.{rng.randrange(10 ** 6)}.z")
+    return [rng.choice(pool) for _ in range(msgs)]
+
+
+def run_route_spec(quick: bool = False) -> dict:
+    """Batched tensor routing vs per-message trie walks, single process,
+    single core: compile time, µs/msg at each binding-table size, parity
+    spot checks, and a 100-group key-shared fan-out through a live broker."""
+    import random
+
+    from chanamq_tpu.router.compile import compile_exchange, route_batch
+
+    rng = random.Random(8)
+    sizes = [1_000, 10_000] if quick else [1_000, 10_000, 100_000]
+    msgs = 2048 if quick else 16384
+    batch = 512
+    out: dict = {"batch": batch, "msgs": msgs, "sizes": {}}
+
+    for n in sizes:
+        m = _route_build_matcher(n)
+        t0 = time.perf_counter()
+        compiled = compile_exchange("topic", m.bindings())
+        compile_s = time.perf_counter() - t0
+        keys = _route_keys(n, msgs, rng)
+        items = [(k, None) for k in keys]
+
+        t0 = time.perf_counter()
+        oracle = [m.route(k) for k in keys]
+        trie_s = time.perf_counter() - t0
+
+        uniq_items = [(k, None) for k in dict.fromkeys(keys)]
+
+        backends = {}
+        mismatches = 0
+        for backend in ("jax", "python"):
+            route_batch(compiled, items[:batch], backend)  # warm (jit)
+            compiled._route_memo.clear()
+            # cold: every key unseen, the all-miss tokenize+kernel path
+            t0 = time.perf_counter()
+            for i in range(0, len(uniq_items), batch):
+                route_batch(compiled, uniq_items[i:i + batch], backend)
+            cold_s = time.perf_counter() - t0
+            # steady state: bounded active keyset, memo-hit path
+            t0 = time.perf_counter()
+            got: list = []
+            for i in range(0, len(items), batch):
+                got.extend(route_batch(compiled, items[i:i + batch],
+                                       backend))
+            backends[backend] = (cold_s, time.perf_counter() - t0)
+            mismatches += sum(
+                1 for g, o in zip(got, oracle) if set(g) != o)
+
+        jax_cold, jax_warm = backends["jax"]
+        out["sizes"][str(n)] = {
+            "bindings": n,
+            "kernel_rows": compiled.kernel_rows,
+            "unique_keys": len(uniq_items),
+            "compile_ms": round(compile_s * 1e3, 2),
+            "trie_us_per_msg": round(trie_s / msgs * 1e6, 3),
+            "batched_jax_us_per_msg": round(jax_warm / msgs * 1e6, 3),
+            "batched_jax_cold_us_per_key": round(
+                jax_cold / len(uniq_items) * 1e6, 3),
+            "batched_numpy_us_per_msg": round(
+                backends["python"][1] / msgs * 1e6, 3),
+            "speedup_vs_trie": round(trie_s / jax_warm, 2),
+            "parity_mismatches": mismatches,
+        }
+
+    if not quick:
+        m = _route_build_matcher(1_000_000)
+        t0 = time.perf_counter()
+        compiled = compile_exchange("topic", m.bindings())
+        out["build_1m_bindings_s"] = round(time.perf_counter() - t0, 3)
+        out["build_1m_kernel_rows"] = compiled.kernel_rows
+
+    groups = 20 if quick else 100
+    records = 100 if quick else 200
+    try:
+        out["key_shared_fanout"] = asyncio.run(asyncio.wait_for(
+            _route_groups_spec(groups, records), timeout=120))
+    except Exception as exc:
+        out["key_shared_fanout"] = {
+            "error": f"{type(exc).__name__}: {exc}"}
+    return out
+
+
+async def _route_groups_spec(groups: int, records: int) -> dict:
+    """N key-shared groups fanning one stream out: every group delivers
+    every record (group count × record count total deliveries), manual
+    ack, 16 partition keys."""
+    from chanamq_tpu.broker.server import BrokerServer
+    from chanamq_tpu.client.client import AMQPClient
+
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0)
+    await srv.start()
+    conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    try:
+        setup = await conn.channel()
+        await setup.queue_declare(
+            "route_ks", durable=True, arguments={"x-queue-type": "stream"})
+        await setup.exchange_declare("route_ksx", "fanout")
+        await setup.queue_bind("route_ks", "route_ksx", "")
+
+        channels = [await conn.channel() for _ in range(4)]
+        total = groups * records
+        seen = [0]
+        done = asyncio.get_event_loop().create_future()
+
+        def on_msg(ch):
+            def cb(msg):
+                ch.basic_ack(msg.delivery_tag)
+                seen[0] += 1
+                if seen[0] >= total and not done.done():
+                    done.set_result(None)
+            return cb
+
+        for g in range(groups):
+            ch = channels[g % len(channels)]
+            await ch.basic_consume(
+                "route_ks", on_msg(ch), consumer_tag=f"ks-bench-{g}",
+                arguments={"x-group": f"g{g}",
+                           "x-group-type": "key-shared",
+                           "x-stream-offset": "first"})
+
+        t0 = time.perf_counter()
+        for i in range(records):
+            setup.basic_publish(b"x" * 32, exchange="route_ksx",
+                                routing_key=f"k{i % 16}")
+        await asyncio.wait_for(done, 90)
+        wall = time.perf_counter() - t0
+        await asyncio.sleep(0.2)  # let trailing acks commit cursors
+        return {
+            "groups": groups,
+            "records": records,
+            "deliveries": total,
+            "wall_s": round(wall, 3),
+            "deliveries_per_s": round(total / wall, 1),
+            "group_cursors_committed": len([
+                k for k in srv.broker.vhosts["/"].queues["route_ks"]
+                .committed if k.startswith("%grp%")]),
+        }
+    finally:
+        try:
+            await conn.close()
+        except Exception:
+            pass
+        await srv.stop()
+
+
 def main() -> None:
     if "--role" in sys.argv:
         import argparse
@@ -1187,6 +1373,33 @@ def main() -> None:
         else:
             asyncio.run(consumer_main(
                 args.port, bool(args.auto_ack), args.seconds, args.queue))
+        return
+
+    if "--route" in sys.argv:
+        # tensorized-router microbench: compiled batch routing vs the
+        # per-message trie, plus the key-shared group fan-out. --quick
+        # shrinks sizes for the tier-1 smoke gate.
+        quick = "--quick" in sys.argv
+        result = run_route_spec(quick=quick)
+        print(f"# route: {result}", file=sys.stderr)
+        headline = result["sizes"].get("10000") or next(
+            iter(result["sizes"].values()), {})
+        parity_bad = sum(s.get("parity_mismatches", 0)
+                         for s in result["sizes"].values())
+        fanout_err = result.get("key_shared_fanout", {}).get("error")
+        print(json.dumps({
+            "metric": "route_batched_us_per_msg_10k_bindings",
+            "value": headline.get("batched_jax_us_per_msg"),
+            "unit": "us/msg",
+            "vs_baseline": None,
+            "trie_us_per_msg": headline.get("trie_us_per_msg"),
+            "speedup_vs_trie": headline.get("speedup_vs_trie"),
+            "parity_mismatches": parity_bad,
+            "cores": os.cpu_count(),
+            "route": result,
+        }))
+        if parity_bad or fanout_err:
+            sys.exit(1)  # the tier-1 smoke must fail loudly
         return
 
     if "--stream" in sys.argv:

@@ -84,6 +84,12 @@ class Broker:
         flow_publish_credit: int = 0,
         flow_consumer_buffer: int = 0,
         park_buffer: Optional[int] = None,
+        router_enabled: bool = True,
+        router_backend: str = "jax",
+        router_min_batch: int = 16,
+        router_max_wildcards: int = 512,
+        router_max_queues: int = 4096,
+        router_verify: bool = False,
     ) -> None:
         self.store = store or MemoryStore()
         self.idgen = IdGenerator(node_id)
@@ -231,18 +237,66 @@ class Broker:
         self._cluster_route_cache: Optional[
             dict[tuple[str, str, str], tuple[list, list]]] = {}
         self._cluster_route_strikes = 0
+        # data-parallel batch router (chana.mq.router.*): the fused publish
+        # path defers eligible messages and flushes whole read batches
+        # through compiled binding tables (chanamq_tpu/router/). None when
+        # disabled — every router seam is a `router is not None` check.
+        self.router = None
+        if router_enabled:
+            from ..router.engine import TensorRouter
+
+            self.router = TensorRouter(
+                self, backend=router_backend,
+                min_batch=router_min_batch or 16,
+                max_wildcards=router_max_wildcards or 512,
+                max_queues=router_max_queues or 4096,
+                verify=router_verify)
 
     _ROUTE_CACHE_MAX = 4096
     _ROUTE_CACHE_STRIKES = 4
 
-    def invalidate_routes(self) -> None:
-        """Topology changed: cached publish routes are stale."""
+    def invalidate_routes(self, vhost: Optional[str] = None,
+                          exchange: Optional[str] = None) -> None:
+        """Topology changed: cached publish routes are stale. Mutation
+        sites that know the one exchange affected pass (vhost, exchange)
+        so the batch router recompiles only that table; bulk sites
+        (recovery, vhost ops, queue deletion — which unbinds across
+        exchanges) pass nothing and everything goes dirty. The flat route
+        caches always clear outright either way."""
         if self._route_cache:
             self._route_cache.clear()
         if self._cluster_route_cache:
             self._cluster_route_cache.clear()
         if self.cluster is not None:
             self.cluster.resolve_cache.clear()
+        if self.router is not None:
+            self.router.invalidate(vhost, exchange)
+
+    def flush_deferred_publishes(
+        self, vhost_name: str, entries: list,
+        confirm_marks: Optional[list],
+    ) -> None:
+        """Publish one connection's deferred fused-publish buffer: route
+        the whole batch through the tensor router, then run the same
+        _publish_local the inline path uses, in arrival order. Rows are
+        (exchange, routing_key, props, body, header_raw, exrk_raw,
+        confirmed). Never raises: defer_ok pre-validated the exchanges and
+        nothing can mutate topology between deferral and flush (the
+        connection flushes before every await)."""
+        routes, t0, t1 = self.router.route_pending(vhost_name, entries)
+        metrics = self.metrics
+        for entry, queues in zip(entries, routes):
+            exchange, routing_key, props, body, header, exrk, confirmed = entry
+            metrics.published(len(body))
+            if trace.ACTIVE is not None:
+                tr = trace.ACTIVE.begin_publish(self.trace_node)
+                if tr is not None:
+                    # the whole flush routed as one kernel call: each
+                    # sampled message carries the batch's ROUTE window
+                    tr.span(trace.ROUTE, t0, t1, self.trace_node)
+            self._publish_local(
+                queues, exchange, routing_key, props, body, False,
+                header, confirm_marks if confirmed else None, exrk)
 
     def spawn(self, coro: Awaitable) -> None:
         """Fire-and-forget a coroutine with a strong reference held until
@@ -734,7 +788,7 @@ class Broker:
             auto_delete=auto_delete, internal=internal, arguments=arguments,
         )
         vhost.exchanges[name] = exchange
-        self.invalidate_routes()
+        self.invalidate_routes(vhost_name, name)
         if durable:
             await self.store.insert_exchange(StoredExchange(
                 vhost=vhost_name, name=name, type=ex_type, durable=durable,
@@ -763,7 +817,7 @@ class Broker:
         if if_unused and not exchange.is_unused():
             raise BrokerError(ErrorCode.PRECONDITION_FAILED, f"exchange '{name}' in use")
         del vhost.exchanges[name]
-        self.invalidate_routes()
+        self.invalidate_routes(vhost_name, name)
         # e2e bindings die with the exchange on BOTH sides: its own source
         # matchers go with the object; binds from other exchanges to it are
         # swept here (RabbitMQ parity)
@@ -1004,7 +1058,7 @@ class Broker:
                 ErrorCode.ACCESS_REFUSED, "cannot bind to the default exchange")
         added = exchange.matcher.bind(routing_key, queue_name, arguments)
         if added:
-            self.invalidate_routes()
+            self.invalidate_routes(vhost_name, exchange_name)
         if added and exchange.durable and self._queue_is_durable(vhost_name, queue_name):
             await self.store.insert_bind(
                 vhost_name, exchange_name, queue_name, routing_key, arguments)
@@ -1038,7 +1092,7 @@ class Broker:
         if added:
             # an e2e bind turns a cached single-hop route stale AND makes
             # the source uncacheable (ex_matcher now set)
-            self.invalidate_routes()
+            self.invalidate_routes(vhost_name, source)
         if added and src.durable and dst.durable:
             await self.store.insert_exchange_bind(
                 vhost_name, source, destination, routing_key, arguments)
@@ -1060,7 +1114,7 @@ class Broker:
         removed = (src.ex_matcher is not None
                    and src.ex_matcher.unbind(routing_key, destination, arguments))
         if removed:
-            self.invalidate_routes()
+            self.invalidate_routes(vhost_name, source)
         if removed and src.durable:
             await self.store.delete_exchange_bind(
                 vhost_name, source, destination, routing_key)
@@ -1085,7 +1139,7 @@ class Broker:
             raise BrokerError(ErrorCode.NOT_FOUND, f"no exchange '{exchange_name}'")
         removed = exchange.matcher.unbind(routing_key, queue_name, arguments)
         if removed:
-            self.invalidate_routes()
+            self.invalidate_routes(vhost_name, exchange_name)
         if removed and exchange.durable:
             await self.store.delete_bind(
                 vhost_name, exchange_name, queue_name, routing_key)

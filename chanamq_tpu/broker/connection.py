@@ -234,6 +234,12 @@ class AMQPConnection:
         # does a drain failure escalate to a connection error (best-effort
         # publishes just log, like the pre-pipelining inline path)
         self._remote_pending: list = []
+        # single-node twin of _remote_pending: fused publishes deferred for
+        # the tensor router (chana.mq.router.*) — flushed synchronously
+        # before ANY other command, publish, confirm release, or close, so
+        # per-channel/per-queue FIFO and confirm durability are preserved
+        # exactly as if each message had published inline
+        self._route_pending: list = []
         self._remote_strict = False
         self._remote_failures: list = []
         # tail of the ordered background chain pipelining remote-push
@@ -722,6 +728,10 @@ class AMQPConnection:
                 if isinstance(out, FrameError):
                     await self._hard_close(out.code, out.message)
                     return False
+                # a generic command may publish, mutate topology, or read
+                # queue state: deferred publishes must land first
+                if self._route_pending:
+                    self._flush_route_pending()
                 if not await self._run_command(out):
                     return False
         return True
@@ -826,6 +836,26 @@ class AMQPConnection:
         self._fused_skip = consumed
         broker = self.broker
         if broker.cluster is None:
+            router = broker.router
+            if router is not None and router.defer_ok(
+                    self.vhost_name, exchange):
+                # batch routing: buffer the decoded publish; the whole
+                # read batch routes in one kernel call at the next flush
+                # point. Confirm arming is identical to the inline path —
+                # the confirm can only be RELEASED after a barrier, and
+                # every barrier flushes this buffer first.
+                seq = self._arm_confirm(channel)
+                self._route_pending.append((
+                    exchange, routing_key, props, body, header, exrk_raw,
+                    seq is not None))
+                if seq is not None:
+                    self._pending_confirms[channel_id] = seq
+                    broker.metrics.confirmed_msgs += 1
+                return consumed
+            if self._route_pending:
+                # non-deferrable publish while deferred ones are buffered:
+                # flush first (per-channel/per-queue FIFO)
+                self._flush_route_pending()
             seq = self._arm_confirm(channel)
             broker.publish_sync(
                 self.vhost_name, exchange, routing_key, props, body,
@@ -855,6 +885,16 @@ class AMQPConnection:
             self._pending_confirms[channel_id] = seq
             self.broker.metrics.confirmed_msgs += 1
         return consumed
+
+    def _flush_route_pending(self) -> None:
+        """Route + publish the deferred fused publishes, in arrival order,
+        through one batched router call. Synchronous: the single-node
+        publish path never awaits, so a flush can run at any point of
+        read-batch processing without yielding the event loop (which is
+        exactly what makes deferral invisible to other connections)."""
+        entries, self._route_pending = self._route_pending, []
+        self.broker.flush_deferred_publishes(
+            self.vhost_name, entries, self._confirm_marks)
 
     async def _batch_barrier(self) -> None:
         """Per-read-batch barrier. When ONLY pipelined remote pushes gate
@@ -929,6 +969,10 @@ class AMQPConnection:
         blob + queue-log rows — all in one group-commit batch). Free for
         single-node transient traffic: with no remote pushes and no enqueue
         windows recorded, flush([]) resolves immediately."""
+        if self._route_pending:
+            # deferred publishes must enqueue their store writes (and
+            # record their marks) before the marks are consumed below
+            self._flush_route_pending()
         await self._settle_remote_failures()
         if self._pending_confirms:
             intervals, self._confirm_marks = self._confirm_marks, []
@@ -1744,7 +1788,7 @@ class AMQPConnection:
             # attach position must be parseable BEFORE ConsumeOk goes out —
             # a post-Ok failure would leave the client believing it is
             # subscribed
-            from ..streams import parse_offset_spec
+            from ..streams import parse_offset_spec, validate_group_args
 
             try:
                 parse_offset_spec(
@@ -1753,6 +1797,16 @@ class AMQPConnection:
                 raise ChannelError(
                     ErrorCode.PRECONDITION_FAILED, str(exc),
                     method.CLASS_ID, method.METHOD_ID) from None
+            group_err = validate_group_args(queue, method.arguments)
+            if group_err is not None:
+                raise ChannelError(
+                    ErrorCode.PRECONDITION_FAILED, group_err,
+                    method.CLASS_ID, method.METHOD_ID)
+        elif (method.arguments or {}).get("x-group") is not None:
+            raise ChannelError(
+                ErrorCode.PRECONDITION_FAILED,
+                "x-group requires a stream queue (x-queue-type: stream)",
+                method.CLASS_ID, method.METHOD_ID)
         consumer = Consumer(
             tag, channel, queue, method.no_ack, method.exclusive, method.arguments)
         channel.consumers[tag] = consumer

@@ -269,6 +269,14 @@ class BrokerServer:
             park_buffer=config.size_bytes("chana.mq.flow.park-buffer"),
             flow_page_resident=config.int("chana.mq.flow.page-resident")
             or 0,
+            router_enabled=config.bool("chana.mq.router.enabled"),
+            router_backend=config.str("chana.mq.router.backend") or "jax",
+            router_min_batch=config.int("chana.mq.router.min-batch") or 16,
+            router_max_wildcards=config.int(
+                "chana.mq.router.max-wildcards") or 512,
+            router_max_queues=config.int("chana.mq.router.max-queues")
+            or 4096,
+            router_verify=config.bool("chana.mq.router.verify"),
         )
         if store is not None and hasattr(store, "metrics"):
             # the WAL engine's wal_* counters must land in the broker

@@ -391,6 +391,10 @@ async def run_soak(
         stream = await _stream_cursor_check(
             b_srv, sq, stream_records, violations)
 
+        # -- key-shared group ordering through a member disconnect (on B)
+        key_shared = await _key_shared_group_check(
+            b_srv, placed("ks", b_cl, c_cl), violations)
+
         # -- deterministic alert firings (invariant 6b) on the survivor
         alerts = await _alert_phase(b_srv, b_cl, violations)
 
@@ -412,6 +416,7 @@ async def run_soak(
             "crashed": crashed.is_set(),
             "max_backoff_s": round(max_backoff_seen, 3),
             "stream": stream,
+            "key_shared": key_shared,
             "health_gate": health_gate,
             "alerts": alerts,
             "chaos": runtime.status(),
@@ -1907,3 +1912,104 @@ async def _stream_cursor_check(
             await conn.close()
         except Exception:
             pass
+
+
+async def _key_shared_group_check(srv, qname: str, violations: list[str]) -> dict:
+    """Invariant 7 (PR 13): a key-shared group member disconnecting with
+    deliveries in flight must NOT reorder any key. Its records requeue and
+    redeliver to the survivor before any later record of the same keys, so
+    the survivor's per-key ack sequence is strictly increasing and the
+    group ends complete (every published record acked exactly once)."""
+    from ..client.client import AMQPClient
+
+    keys = [f"k{i}" for i in range(4)]
+    per_key_records = 6
+    total = per_key_records * len(keys)
+    group_args = {"x-group": "soak-ks", "x-group-type": "key-shared",
+                  "x-stream-offset": "first"}
+
+    pub = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    victim = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    survivor = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    try:
+        pch = await pub.channel()
+        await pch.queue_declare(
+            qname, durable=True, arguments={"x-queue-type": "stream"})
+        await pch.exchange_declare(qname + "-x", "fanout")
+        await pch.queue_bind(qname, qname + "-x", "")
+
+        # the victim takes a prefetch window and never acks
+        vch = await victim.channel()
+        await vch.basic_qos(prefetch_count=6)
+        victim_held = asyncio.Event()
+        victim_got: list = []
+
+        def victim_cb(msg):
+            victim_got.append(msg.routing_key)
+            if len(victim_got) >= 6:
+                victim_held.set()
+
+        await vch.basic_consume(qname, victim_cb, consumer_tag="ks-victim",
+                                arguments=dict(group_args))
+
+        sch = await survivor.channel()
+        acked: list = []  # (key, seq) in ack order
+        complete = asyncio.Event()
+
+        def survivor_cb(msg):
+            acked.append((msg.routing_key, int(bytes(msg.body))))
+            sch.basic_ack(msg.delivery_tag)
+            if len(acked) >= total:
+                complete.set()
+
+        await sch.basic_consume(qname, survivor_cb,
+                                consumer_tag="ks-survivor",
+                                arguments=dict(group_args))
+
+        await pch.confirm_select()
+        for seq in range(per_key_records):
+            for key in keys:
+                pch.basic_publish(str(seq).encode(), exchange=qname + "-x",
+                                  routing_key=key)
+        await pch.wait_unconfirmed_below(1, timeout=30)
+        try:
+            await asyncio.wait_for(victim_held.wait(), 15)
+        except asyncio.TimeoutError:
+            violations.append("key-shared: victim member never saturated "
+                              "its prefetch window")
+        early = len(acked)  # every key stuck to the victim: should be 0
+        await victim.close()  # mid-flight disconnect: requeue + rebalance
+        try:
+            await asyncio.wait_for(complete.wait(), 15)
+        except asyncio.TimeoutError:
+            violations.append(
+                f"key-shared: survivor drained only {len(acked)}/{total} "
+                "records after the member disconnect")
+        ordered = True
+        per_key: dict[str, list] = {}
+        for key, seq in acked:
+            per_key.setdefault(key, []).append(seq)
+        for key, seqs in per_key.items():
+            if seqs != sorted(set(seqs)):
+                ordered = False
+                violations.append(
+                    f"key-shared: key {key} acked out of order after "
+                    f"redelivery: {seqs}")
+        want = sorted(list(range(per_key_records)) * len(keys))
+        if sorted(s for v in per_key.values() for s in v) != want:
+            violations.append(
+                "key-shared: records lost or duplicated across the "
+                "disconnect")
+        return {
+            "records": total,
+            "keys": len(keys),
+            "victim_held": len(victim_got),
+            "acked_before_disconnect": early,
+            "per_key_ordered": ordered,
+        }
+    finally:
+        for conn in (pub, victim, survivor):
+            try:
+                await conn.close()
+            except Exception:
+                pass

@@ -312,6 +312,28 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.trace.slow-ms": 250,
     # structured JSON log lines stamped with node id + active trace id
     "chana.mq.log.json": False,
+    # data-parallel tensorized router (chanamq_tpu/router/): fused single
+    # node publishes defer into a per-connection buffer and the whole read
+    # batch routes through compiled binding tables in one kernel call.
+    # The Python matchers stay as the always-available fallback (and the
+    # parity oracle); disabling restores per-message routing everywhere.
+    "chana.mq.router.enabled": True,
+    # "jax" runs the match kernels under jax.jit; "python" runs the same
+    # kernel body on plain numpy (runtime-selectable pure-Python fallback)
+    "chana.mq.router.backend": "jax",
+    # flushes smaller than this skip the kernel and walk the matcher —
+    # below ~16 messages the per-call dispatch overhead beats the win
+    "chana.mq.router.min-batch": 16,
+    # caps on what compiles: an exchange with more wildcard topic patterns
+    # (or headers bindings) than max-wildcards, or more kernel-routed
+    # queues than max-queues, stays on the Python matcher. Exact-match
+    # patterns are host dicts and don't count against either cap.
+    "chana.mq.router.max-wildcards": 512,
+    "chana.mq.router.max-queues": 4096,
+    # cross-check every kernel batch against the Python oracle and prefer
+    # the oracle on mismatch (router_parity_mismatches counts them) —
+    # a debugging net, not for production throughput
+    "chana.mq.router.verify": False,
 }
 
 _DURATION_RE = re.compile(r"^\s*([0-9.]+)\s*(ms|s|m|h|d)?\s*$")

@@ -532,7 +532,8 @@ class AdminServer:
         "repl_ack_timeouts",
         "stream_appends", "stream_append_bytes", "stream_segments_sealed",
         "stream_segments_truncated", "stream_records_delivered",
-        "stream_cursor_commits",
+        "stream_cursor_commits", "stream_groups_created",
+        "stream_group_deliveries",
         "chaos_fires", "chaos_latency", "chaos_errors", "chaos_drops",
         "chaos_disconnects", "chaos_corrupt_frames", "chaos_crashes",
         "chaos_partition_drops",
@@ -549,6 +550,8 @@ class AdminServer:
         "lifecycle_evacuation_retries", "lifecycle_rollbacks",
         "lifecycle_stale_epoch_refused", "lifecycle_join_rebalances",
         "lifecycle_stale_holders_cleared",
+        "router_batches", "router_batch_msgs", "router_compiles",
+        "router_fallback_msgs", "router_parity_mismatches",
     })
 
     @staticmethod
@@ -749,6 +752,10 @@ class AdminServer:
                         }
                         for name in sorted(names)
                     },
+                    "groups": [
+                        group.snapshot()
+                        for _, group in sorted(queue._groups.items())
+                    ],
                 })
         return out
 

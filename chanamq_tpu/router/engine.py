@@ -1,0 +1,206 @@
+"""TensorRouter: batched publish routing over compiled binding tables.
+
+The broker owns one TensorRouter (``chana.mq.router.enabled``). The
+connection read loop, instead of routing each fused publish inline, defers
+eligible messages into a per-connection buffer and flushes the WHOLE read
+batch through ``Broker.flush_deferred_publishes`` -> ``route_pending``
+here: one compiled-table lookup per exchange and one jitted kernel call
+per exchange per flush, instead of one trie walk per message.
+
+Consistency model (why deferral is safe):
+
+- Deferral only happens between awaits of a single connection's read-batch
+  processing, and every path that can publish, run a generic AMQP command,
+  release confirms, or close the connection flushes the buffer FIRST
+  (synchronously — the single-node publish path never awaits). The event
+  loop is single-threaded, so no other connection's topology mutation can
+  interleave with an unflushed buffer: the vhost/exchange state observed
+  at ``defer_ok`` time is still live at flush time.
+- ``Broker.invalidate_routes(vhost, exchange)`` drops exactly that
+  exchange's compiled snapshot (or all of them for bulk mutations);
+  recompilation is lazy, at the next flush that routes through it, under a
+  monotonically increasing generation counter. Snapshots are immutable —
+  a flush in progress keeps routing against the snapshot it resolved.
+- Exchanges the compiler rejects (``Uncompilable``) and sub-``min-batch``
+  kernel batches fall back to the exchange's Python matcher — the always
+  available, always-correct oracle. ``chana.mq.router.verify`` cross-checks
+  every kernel result against the oracle and prefers the oracle on any
+  mismatch (counted in ``router_parity_mismatches``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import TYPE_CHECKING, Optional
+
+from . import compile as rcompile
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..broker.broker import Broker
+
+log = logging.getLogger("chanamq.router")
+
+_DEFERRABLE_TYPES = ("direct", "fanout", "topic", "headers")
+
+# resolved (vhost, name-set) -> [Queue] memo cap; cleared on invalidate
+_QUEUE_CACHE_CAP = 8192
+
+
+class TensorRouter:
+    """Per-broker batch router over compiled binding tables."""
+
+    def __init__(
+        self,
+        broker: "Broker",
+        *,
+        backend: str = "jax",
+        min_batch: int = 16,
+        max_wildcards: int = 512,
+        max_queues: int = 4096,
+        verify: bool = False,
+    ) -> None:
+        self.broker = broker
+        self.backend = backend if backend in ("jax", "python") else "jax"
+        self.min_batch = max(1, min_batch)
+        self.max_wildcards = max_wildcards
+        self.max_queues = max_queues
+        self.verify = verify
+        self.generation = 0
+        # (vhost, exchange) -> CompiledExchange | str (uncompilable reason)
+        self._compiled: dict = {}
+        # (vhost, exchange) -> bool deferral decision memo
+        self._defer: dict = {}
+        # (vhost, frozenset-of-names) -> [Queue]
+        self._queue_cache: dict = {}
+
+    # -- invalidation ------------------------------------------------------
+
+    def invalidate(self, vhost: Optional[str] = None,
+                   exchange: Optional[str] = None) -> None:
+        """Topology changed. With a (vhost, exchange) only that snapshot is
+        dropped (dirty-exchange batching: untouched tables keep their
+        compiled form); bulk mutations drop everything. Either way the
+        deferral decisions and resolved-queue memo reset — they embed
+        exchange structure and live Queue objects."""
+        self._defer.clear()
+        self._queue_cache.clear()
+        if vhost is None or exchange is None:
+            self._compiled.clear()
+        else:
+            self._compiled.pop((vhost, exchange), None)
+
+    # -- deferral decision (publish hot path) ------------------------------
+
+    def defer_ok(self, vhost_name: str, exchange_name: str) -> bool:
+        """Whether a fused publish to this exchange may be deferred into
+        the batch buffer. Memoized; any invalidate() clears the memo. The
+        structural checks guarantee a later flush cannot raise: the
+        exchange exists, is externally publishable, and carries none of
+        the semantics (alternate exchange, e2e bindings) the batch path
+        doesn't implement."""
+        key = (vhost_name, exchange_name)
+        ok = self._defer.get(key)
+        if ok is None:
+            ok = self._defer[key] = self._compute_defer(
+                vhost_name, exchange_name)
+        return ok
+
+    def _compute_defer(self, vhost_name: str, exchange_name: str) -> bool:
+        if exchange_name == "":
+            return False  # default exchange: the dict hit is already optimal
+        vhost = self.broker.vhosts.get(vhost_name)
+        if vhost is None:
+            return False
+        exchange = vhost.exchanges.get(exchange_name)
+        if exchange is None or exchange.internal:
+            return False
+        if exchange.ex_matcher is not None or exchange.alternate is not None:
+            return False
+        return exchange.type in _DEFERRABLE_TYPES
+
+    # -- batch routing -----------------------------------------------------
+
+    def _get_compiled(self, vhost, vhost_name: str, exchange_name: str):
+        key = (vhost_name, exchange_name)
+        comp = self._compiled.get(key)
+        if comp is None:
+            exchange = vhost.exchanges[exchange_name]
+            self.generation += 1
+            metrics = self.broker.metrics
+            metrics.router_generation = self.generation
+            try:
+                comp = rcompile.compile_exchange(
+                    exchange.type, exchange.matcher.bindings(),
+                    generation=self.generation,
+                    max_wildcards=self.max_wildcards,
+                    max_queues=self.max_queues)
+                metrics.router_compiles += 1
+            except rcompile.Uncompilable as exc:
+                comp = exc.reason
+                log.debug("exchange %s/%s not tensorizable: %s",
+                          vhost_name, exchange_name, exc.reason)
+            self._compiled[key] = comp
+        return None if isinstance(comp, str) else comp
+
+    def _queues(self, vhost_name: str, vhost, names) -> list:
+        """Resolve a routed name-set to live Queue objects, memoized per
+        distinct set (fan-out traffic repeats a handful of sets)."""
+        cache = self._queue_cache
+        key = (vhost_name, names)
+        queues = cache.get(key)
+        if queues is None:
+            vq = vhost.queues
+            queues = [vq[n] for n in names if n in vq]
+            if len(cache) >= _QUEUE_CACHE_CAP:
+                cache.clear()
+            cache[key] = queues
+        return queues
+
+    def route_pending(self, vhost_name: str, entries: list):
+        """Route one deferred flush. ``entries`` rows are
+        ``(exchange, routing_key, props, body, header_raw, exrk_raw,
+        confirmed)``; returns ``(queues_per_entry, t0_ns, t1_ns)`` with the
+        batch routing window for ROUTE span stamping."""
+        t0 = time.perf_counter_ns()
+        metrics = self.broker.metrics
+        vhost = self.broker.vhosts[vhost_name]
+        out: list = [None] * len(entries)
+        # group by exchange: one compiled snapshot + one kernel call each
+        groups: dict[str, list[int]] = {}
+        for idx, entry in enumerate(entries):
+            groups.setdefault(entry[0], []).append(idx)
+        for exchange_name, idxs in groups.items():
+            compiled = self._get_compiled(vhost, vhost_name, exchange_name)
+            use_kernel = compiled is not None and (
+                compiled.kernel_rows == 0 or len(idxs) >= self.min_batch)
+            if not use_kernel:
+                # Python matcher fallback: uncompilable table, or a batch
+                # too small to amortize the kernel dispatch
+                metrics.router_fallback_msgs += len(idxs)
+                matcher = vhost.exchanges[exchange_name].matcher
+                for idx in idxs:
+                    entry = entries[idx]
+                    names = frozenset(
+                        matcher.route(entry[1], entry[2].headers))
+                    out[idx] = self._queues(vhost_name, vhost, names)
+                continue
+            items = [(entries[i][1], entries[i][2].headers) for i in idxs]
+            name_sets = rcompile.route_batch(compiled, items, self.backend)
+            if self.verify:
+                matcher = vhost.exchanges[exchange_name].matcher
+                for pos, (key, headers) in enumerate(items):
+                    oracle = matcher.route(key, headers)
+                    if set(name_sets[pos]) != oracle:
+                        metrics.router_parity_mismatches += 1
+                        log.error(
+                            "router parity mismatch on %s/%s key=%r: "
+                            "kernel=%r oracle=%r", vhost_name, exchange_name,
+                            key, sorted(name_sets[pos]), sorted(oracle))
+                        name_sets[pos] = frozenset(oracle)
+            metrics.router_batches += 1
+            metrics.router_batch_msgs += len(idxs)
+            metrics.router_batch_size.observe_us(len(idxs))
+            for idx, names in zip(idxs, name_sets):
+                out[idx] = self._queues(vhost_name, vhost, names)
+        return out, t0, time.perf_counter_ns()

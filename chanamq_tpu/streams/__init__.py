@@ -4,6 +4,12 @@ See streams/queue.py for semantics; selected per queue with
 ``x-queue-type: stream`` at declare time.
 """
 
+from .groups import (  # noqa: F401
+    GROUP_CURSOR_PREFIX,
+    GROUP_MODES,
+    StreamGroup,
+    validate_group_args,
+)
 from .queue import (  # noqa: F401
     GET_CURSOR,
     VALID_QUEUE_TYPES,

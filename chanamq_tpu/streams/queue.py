@@ -34,10 +34,12 @@ from ..broker.entities import Delivery, Message, Queue, QueuedMessage, now_ms
 from .segment import (
     Segment, StreamRecord, pack_records, unpack_records_indexed,
 )
+from .groups import GROUP_CURSOR_PREFIX, validate_group_args  # noqa: F401
 
 if TYPE_CHECKING:  # pragma: no cover
     from ..broker.broker import Broker
     from ..broker.channel import Consumer
+    from .groups import StreamGroup
 
 log = logging.getLogger("chanamq.streams")
 
@@ -148,6 +150,10 @@ class StreamQueue(Queue):
         # (committed survives detach and, durably, restarts)
         self._cursors: dict[str, StreamCursor] = {}
         self.committed: dict[str, int] = {}
+        # consumer groups (x-group): shared read position per group name,
+        # plus member-tag -> group for settle-path delegation
+        self._groups: dict[str, "StreamGroup"] = {}
+        self._member_groups: dict[str, "StreamGroup"] = {}
         self._cursor_dirty: set[str] = set()
         self._cursor_flush_scheduled = False
         # segment blob loads in flight (base offsets)
@@ -362,7 +368,7 @@ class StreamQueue(Queue):
     def schedule_dispatch(self) -> None:  # type: ignore[override]
         if self._dispatch_scheduled or self.deleted:
             return
-        if not self._cursors:
+        if not self._cursors and not self._groups:
             return
         self._dispatch_scheduled = True
         asyncio.get_event_loop().call_soon(self._dispatch)
@@ -415,6 +421,9 @@ class StreamQueue(Queue):
                         self.broker.queue_unacked += 1
             if delivered >= self.delivery_batch:
                 more = True  # budget exhausted, not credit: keep going
+        for group in list(self._groups.values()):
+            if group.dispatch(self.delivery_batch):
+                more = True
         if more:
             self.schedule_dispatch()
 
@@ -461,7 +470,12 @@ class StreamQueue(Queue):
         if popped is not None and self._counted:
             self.broker.queue_unacked -= 1
         self.n_acked += 1
-        self._commit(name, delivery.queued.offset)
+        group = self._member_groups.get(name)
+        if group is not None:
+            # group member: the shared floor commits, not a private cursor
+            group.settle(delivery.queued.offset)
+        else:
+            self._commit(name, delivery.queued.offset)
         self.broker.unrefer(delivery.queued.message)
 
     def drop(self, delivery: Delivery) -> None:  # type: ignore[override]
@@ -477,9 +491,13 @@ class StreamQueue(Queue):
         popped = self.outstanding.pop((name, delivery.queued.offset), None)
         if popped is not None and self._counted:
             self.broker.queue_unacked -= 1
-        cursor = self._cursors.get(name)
-        if cursor is not None and delivery.queued.offset < cursor.next:
-            cursor.next = delivery.queued.offset
+        group = self._member_groups.get(name)
+        if group is not None:
+            group.requeue(name, delivery.queued.offset)
+        else:
+            cursor = self._cursors.get(name)
+            if cursor is not None and delivery.queued.offset < cursor.next:
+                cursor.next = delivery.queued.offset
         self.broker.unrefer(delivery.queued.message)
         self.schedule_dispatch()
 
@@ -525,6 +543,10 @@ class StreamQueue(Queue):
     # -- consumers (cursor attach / detach) ----------------------------------
 
     def add_consumer(self, consumer: "Consumer") -> None:  # type: ignore[override]
+        group_name = (consumer.arguments or {}).get("x-group")
+        if group_name:
+            self._join_group(consumer, group_name)
+            return
         kind, arg = parse_offset_spec(
             (consumer.arguments or {}).get("x-stream-offset"))
         skip_ts: Optional[int] = None
@@ -547,6 +569,43 @@ class StreamQueue(Queue):
             consumer.tag, consumer, start, skip_ts)
         super().add_consumer(consumer)
 
+    def _join_group(self, consumer: "Consumer", group_name: str) -> None:
+        """x-group consume: attach to (or create) the named group instead
+        of a private cursor. Validation (mode vocabulary, mode conflicts)
+        already ran in connection._on_consume before ConsumeOk."""
+        from .groups import StreamGroup
+
+        group = self._groups.get(group_name)
+        if group is None:
+            mode = ((consumer.arguments or {}).get("x-group-type")
+                    or "shared")
+            group = StreamGroup(self, group_name, mode)
+            # position: a previously committed group offset wins (the
+            # group resumes across restarts / full member churn); else the
+            # FOUNDING member's x-stream-offset seeds it
+            committed = self.committed.get(group.cursor_name)
+            if committed is not None:
+                group.next = max(committed + 1, self.first_offset)
+            else:
+                kind, arg = parse_offset_spec(
+                    (consumer.arguments or {}).get("x-stream-offset"))
+                if kind == "first":
+                    group.next = self.first_offset
+                elif kind == "last":
+                    group.next = max(self.first_offset, self.next_offset - 1)
+                elif kind == "offset":
+                    group.next = max(arg, self.first_offset)
+                elif kind == "timestamp":
+                    group.next = self._offset_for_ts(arg)
+                    group.skip_ts_ms = arg
+                else:  # "next"
+                    group.next = self.next_offset
+            self._groups[group_name] = group
+            self.broker.metrics.stream_groups_created += 1
+        group.add_member(consumer)
+        self._member_groups[consumer.tag] = group
+        super().add_consumer(consumer)
+
     def _offset_for_ts(self, ts_ms: int) -> int:
         """First offset whose record could be >= ts_ms, by segment
         metadata; the cursor's skip filter does the exact record match."""
@@ -556,6 +615,9 @@ class StreamQueue(Queue):
         return self._active_base
 
     def remove_consumer(self, consumer: "Consumer") -> bool:  # type: ignore[override]
+        group = self._member_groups.get(consumer.tag)
+        if group is not None and group.members.get(consumer.tag) is consumer:
+            group.remove_member(consumer.tag)
         cursor = self._cursors.get(consumer.tag)
         if cursor is not None and cursor.consumer is consumer:
             del self._cursors[consumer.tag]

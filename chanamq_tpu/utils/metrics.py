@@ -86,6 +86,9 @@ class Metrics:
         self.stream_segments_truncated = 0
         self.stream_records_delivered = 0
         self.stream_cursor_commits = 0
+        # consumer groups on streams (streams/groups.py)
+        self.stream_groups_created = 0
+        self.stream_group_deliveries = 0
         # cluster interconnect data plane (cluster/dataplane.py): binary
         # frame volume, batch sizes, and what cut each batch (window timer,
         # byte cap, count cap, or a barrier demanding an early flush)
@@ -199,6 +202,20 @@ class Metrics:
         self.lifecycle_stale_epoch_refused = 0
         self.lifecycle_join_rebalances = 0
         self.lifecycle_stale_holders_cleared = 0
+        # tensorized router (chanamq_tpu/router/): kernel batches routed,
+        # messages in them, table compiles + the current generation (gauge),
+        # messages that fell back to the Python matcher (uncompilable
+        # exchange or sub-min-batch flush), and verify-mode parity
+        # mismatches (always 0 unless a kernel bug slips parity testing).
+        # router_batch_size is a Histogram over flush batch sizes —
+        # messages per kernel call, not microseconds.
+        self.router_batches = 0
+        self.router_batch_msgs = 0
+        self.router_compiles = 0
+        self.router_generation = 0
+        self.router_fallback_msgs = 0
+        self.router_parity_mismatches = 0
+        self.router_batch_size = Histogram()
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -215,6 +232,7 @@ class Metrics:
             "publish_to_deliver_us": self.publish_to_deliver_us,
             "repl_ack_us": self.repl_ack_us,
             "wal_commit_us": self.wal_commit_us,
+            "router_batch_size": self.router_batch_size,
         }
         out.update(self.trace_stage_us)
         return out
@@ -255,6 +273,8 @@ class Metrics:
             "stream_segments_truncated": self.stream_segments_truncated,
             "stream_records_delivered": self.stream_records_delivered,
             "stream_cursor_commits": self.stream_cursor_commits,
+            "stream_groups_created": self.stream_groups_created,
+            "stream_group_deliveries": self.stream_group_deliveries,
             "rpc_data_bytes_sent": self.rpc_data_bytes_sent,
             "rpc_data_bytes_recv": self.rpc_data_bytes_recv,
             "rpc_push_records": self.rpc_push_records,
@@ -339,6 +359,15 @@ class Metrics:
             "lifecycle_join_rebalances": self.lifecycle_join_rebalances,
             "lifecycle_stale_holders_cleared":
                 self.lifecycle_stale_holders_cleared,
+            "router_batches": self.router_batches,
+            "router_batch_msgs": self.router_batch_msgs,
+            "router_compiles": self.router_compiles,
+            "router_generation": self.router_generation,
+            "router_fallback_msgs": self.router_fallback_msgs,
+            "router_parity_mismatches": self.router_parity_mismatches,
+            "router_batch_size_p50": self.router_batch_size.percentile_us(0.50),
+            "router_batch_size_p99": self.router_batch_size.percentile_us(0.99),
+            "router_batch_size_mean": self.router_batch_size.mean_us,
         }
         for key, hist in self.trace_stage_us.items():
             base = key[:-3] if key.endswith("_us") else key

@@ -161,4 +161,19 @@ BENCH_SECONDS=5 timeout -k 10 120 python bench.py --stream || {
     echo "tier1: stream bench smoke FAILED (rc=$rc)" >&2
     exit "$rc"
 }
+
+echo "tier1: route microbench smoke (tensor router vs trie, parity gate)"
+# the bench itself fails (exit 1) on any kernel/oracle parity mismatch or
+# a broken key-shared fan-out; the grep double-checks both batched paths
+# really routed with zero mismatches at every table size
+timeout -k 10 240 python bench.py --route --quick \
+        | tee /tmp/_t1_route.json || {
+    rc=$?
+    echo "tier1: route smoke FAILED (rc=$rc) — parity mismatch or fan-out error" >&2
+    exit "$rc"
+}
+grep -q '"parity_mismatches": 0' /tmp/_t1_route.json || {
+    echo "tier1: route smoke report missing the zero-mismatch parity gate" >&2
+    exit 1
+}
 echo "tier1: OK"

@@ -1,0 +1,384 @@
+"""Tensorized router tests: randomized parity fuzzing of the compiled
+kernels against the Python matcher oracles (and the native C++ trie when
+built), engine/invalidation behavior, and the end-to-end deferred publish
+path through a real connection.
+
+The parity gate of ISSUE 13: TopicMatcher, NativeTopicMatcher, and the
+tensor router must return identical destination sets over thousands of
+generated bind/unbind/route sequences, including ``#`` edge cases."""
+
+import asyncio
+import random
+
+import pytest
+
+from chanamq_tpu import native_ext
+from chanamq_tpu.amqp.properties import BasicProperties
+from chanamq_tpu.broker.broker import Broker
+from chanamq_tpu.broker.matchers import (
+    DirectMatcher, FanoutMatcher, HeadersMatcher, TopicMatcher,
+)
+from chanamq_tpu.broker.server import BrokerServer
+from chanamq_tpu.client import AMQPClient
+from chanamq_tpu.router import compile as rcompile
+from chanamq_tpu.router.compile import Uncompilable, compile_exchange, route_batch
+
+WORDS = ["a", "b", "c", "dd", "e1", "", "orders", "x"]
+
+
+def _rand_pattern(rng):
+    return ".".join(
+        rng.choice(WORDS + ["*", "#"]) for _ in range(rng.randint(1, 6)))
+
+
+def _rand_key(rng):
+    return ".".join(rng.choice(WORDS) for _ in range(rng.randint(0, 6)))
+
+
+def _route_all_backends(compiled, items):
+    """Route via numpy and jit; assert the two kernels agree, return one.
+
+    The result memo is cleared between backends — topic results are
+    memoized by bare routing key, so without the clear the second
+    backend would serve every answer from the first backend's kernel."""
+    py = route_batch(compiled, items, "python")
+    compiled._route_memo.clear()
+    jx = route_batch(compiled, items, "jax")
+    assert [set(a) for a in py] == [set(b) for b in jx]
+    return py
+
+
+# ---------------------------------------------------------------------------
+# parity fuzz: compiled kernels vs Python trie vs native trie
+# ---------------------------------------------------------------------------
+
+
+def test_topic_parity_fuzz():
+    """Thousands of randomized bind/unbind/route sequences: the Python
+    trie, the native trie (when built), and both tensor backends must be
+    destination-set identical."""
+    rng = random.Random(0xC0FFEE)
+    native = native_ext.available()
+    for trial in range(150):
+        py = TopicMatcher()
+        nat = native_ext.NativeTopicMatcher() if native else None
+        bound = []
+        for _ in range(rng.randint(1, 30)):
+            pattern, queue = _rand_pattern(rng), f"q{rng.randint(0, 9)}"
+            py.bind(pattern, queue)
+            if nat is not None:
+                nat.bind(pattern, queue)
+            bound.append((pattern, queue))
+        # interleave some unbinds so pruning paths run too
+        for _ in range(rng.randint(0, len(bound) // 2)):
+            pattern, queue = rng.choice(bound)
+            py.unbind(pattern, queue)
+            if nat is not None:
+                nat.unbind(pattern, queue)
+        try:
+            compiled = compile_exchange("topic", py.bindings())
+        except Uncompilable:
+            # multi-# pattern: the tensor router would fall back to the
+            # matcher; nothing to diff, but native must still agree
+            if nat is not None:
+                for _ in range(10):
+                    key = _rand_key(rng)
+                    assert nat.route(key) == py.route(key), key
+            continue
+        keys = [_rand_key(rng) for _ in range(rng.randint(1, 40))]
+        got = _route_all_backends(compiled, [(k, None) for k in keys])
+        for key, names in zip(keys, got):
+            oracle = py.route(key)
+            assert set(names) == oracle, (key, sorted(py._patterns))
+            if nat is not None:
+                assert nat.route(key) == oracle, key
+
+
+def test_topic_hash_edge_cases():
+    """The '#' grammar corners: zero-word match, leading/trailing/middle
+    '#', '#' vs empty words, and the lone-'#' always-match fold."""
+    cases = [
+        (["#"], ["", "a", "a.b.c"]),
+        (["a.#"], ["a", "a.b", "a.b.c", "b.a", ""]),
+        (["#.a"], ["a", "b.a", "a.a.a", "a.b"]),
+        (["a.#.b"], ["a.b", "a.x.b", "a.x.y.b", "a", "b"]),
+        (["*.#"], ["", "a", "a.b", "a.b.c"]),
+        (["#.*"], ["", "a", "a.b"]),
+        (["..#"], ["", ".", "..", "..a", ".a."]),
+        (["#.b.*"], ["b.a", "x.b.a", "b.b.b", "b"]),
+        (["a.*.c", "a.#"], ["a.b.c", "a.c", "a.b.c.d"]),
+    ]
+    for patterns, keys in cases:
+        py = TopicMatcher()
+        for i, pattern in enumerate(patterns):
+            py.bind(pattern, f"q{i}")
+        compiled = compile_exchange("topic", py.bindings())
+        got = _route_all_backends(compiled, [(k, None) for k in keys])
+        for key, names in zip(keys, got):
+            assert set(names) == py.route(key), (patterns, key)
+
+
+def test_headers_parity_fuzz():
+    rng = random.Random(0xBEEF)
+    values = [1, "s", True, 2.5, "t", 0, False]
+    for trial in range(150):
+        m = HeadersMatcher()
+        for _ in range(rng.randint(1, 15)):
+            args = {f"h{rng.randint(0, 4)}": rng.choice(values)
+                    for _ in range(rng.randint(0, 3))}
+            if rng.random() < 0.8:
+                args["x-match"] = rng.choice(["all", "any"])
+            m.bind("", f"q{rng.randint(0, 6)}", args)
+        compiled = compile_exchange("headers", m.bindings())
+        msgs = []
+        for _ in range(25):
+            msgs.append({f"h{rng.randint(0, 5)}": rng.choice(values)
+                         for _ in range(rng.randint(0, 4))})
+        got = _route_all_backends(compiled, [("", h) for h in msgs])
+        for headers, names in zip(msgs, got):
+            assert set(names) == m.route("", headers), headers
+
+
+def test_headers_unhashable_binding_uncompilable():
+    m = HeadersMatcher()
+    m.bind("", "q0", {"x-match": "all", "h": [1, 2]})
+    with pytest.raises(Uncompilable):
+        compile_exchange("headers", m.bindings())
+
+
+def test_headers_unhashable_message_value_skipped():
+    m = HeadersMatcher()
+    m.bind("", "q0", {"x-match": "any", "h": 1, "g": 2})
+    compiled = compile_exchange("headers", m.bindings())
+    headers = {"h": [1, 2], "g": 2}
+    got = _route_all_backends(compiled, [("", headers)])
+    assert set(got[0]) == m.route("", headers) == {"q0"}
+
+
+def test_direct_fanout_compile():
+    d = DirectMatcher()
+    d.bind("k1", "a")
+    d.bind("k1", "b")
+    d.bind("k2", "c")
+    cd = compile_exchange("direct", d.bindings())
+    got = route_batch(cd, [("k1", None), ("k2", None), ("zzz", None)])
+    assert [set(g) for g in got] == [{"a", "b"}, {"c"}, set()]
+    f = FanoutMatcher()
+    f.bind("ignored", "a")
+    f.bind("", "b")
+    cf = compile_exchange("fanout", f.bindings())
+    got = route_batch(cf, [("anything", None), ("", None)])
+    assert [set(g) for g in got] == [{"a", "b"}, {"a", "b"}]
+
+
+def test_multi_hash_uncompilable_and_caps():
+    m = TopicMatcher()
+    m.bind("a.#.b.#", "q0")
+    with pytest.raises(Uncompilable):
+        compile_exchange("topic", m.bindings())
+    m2 = TopicMatcher()
+    for i in range(5):
+        m2.bind(f"w{i}.*", f"q{i}")
+    with pytest.raises(Uncompilable):
+        compile_exchange("topic", m2.bindings(), max_wildcards=3)
+    with pytest.raises(Uncompilable):
+        compile_exchange("topic", m2.bindings(), max_queues=2)
+    # exact patterns never count against the wildcard cap
+    m3 = TopicMatcher()
+    for i in range(50):
+        m3.bind(f"exact.{i}", f"q{i}")
+    m3.bind("wild.*", "qw")
+    compiled = compile_exchange("topic", m3.bindings(), max_wildcards=1)
+    got = _route_all_backends(
+        compiled, [("exact.7", None), ("wild.x", None), ("nope", None)])
+    assert [set(g) for g in got] == [{"q7"}, {"qw"}, set()]
+
+
+# ---------------------------------------------------------------------------
+# engine: incremental recompile, generations, fallback, verify mode
+# ---------------------------------------------------------------------------
+
+
+def _mk_broker_with_topic(loop):
+    broker = Broker()
+    loop.run_until_complete(broker.create_vhost("/"))
+    loop.run_until_complete(broker.declare_exchange("/", "ex", "topic"))
+    loop.run_until_complete(broker.declare_queue("/", "q1"))
+    loop.run_until_complete(broker.declare_queue("/", "q2"))
+    loop.run_until_complete(broker.bind_queue("/", "q1", "ex", "a.*"))
+    loop.run_until_complete(broker.bind_queue("/", "q2", "ex", "a.b"))
+    return broker
+
+
+def _entries(pairs):
+    props = BasicProperties()
+    return [(ex, rk, props, b"x", None, None, False) for ex, rk in pairs]
+
+
+def test_engine_route_and_incremental_recompile(event_loop):
+    broker = _mk_broker_with_topic(event_loop)
+    router = broker.router
+    router.min_batch = 1
+    routes, _, _ = router.route_pending("/", _entries([("ex", "a.b")] * 4))
+    assert sorted(q.name for q in routes[0]) == ["q1", "q2"]
+    gen1 = router.generation
+    assert broker.metrics.router_compiles == 1
+    # routing again: same snapshot, no recompile
+    router.route_pending("/", _entries([("ex", "a.c")]))
+    assert router.generation == gen1
+    # bind marks exactly this exchange dirty; next flush recompiles
+    event_loop.run_until_complete(
+        broker.bind_queue("/", "q2", "ex", "c.#"))
+    routes, _, _ = router.route_pending("/", _entries([("ex", "c.x.y")]))
+    assert [q.name for q in routes[0]] == ["q2"]
+    assert router.generation == gen1 + 1
+    assert broker.metrics.router_compiles == 2
+
+
+def test_engine_python_backend_and_fallback(event_loop):
+    broker = _mk_broker_with_topic(event_loop)
+    router = broker.router
+    router.min_batch = 1
+    router.backend = "python"
+    routes, _, _ = router.route_pending("/", _entries([("ex", "a.z")]))
+    assert [q.name for q in routes[0]] == ["q1"]
+    # an uncompilable table falls back to the matcher transparently
+    event_loop.run_until_complete(
+        broker.bind_queue("/", "q1", "ex", "#.mid.#"))
+    before = broker.metrics.router_fallback_msgs
+    routes, _, _ = router.route_pending("/", _entries([("ex", "x.mid.y")]))
+    assert [q.name for q in routes[0]] == ["q1"]
+    assert broker.metrics.router_fallback_msgs == before + 1
+
+
+def test_engine_min_batch_falls_back(event_loop):
+    broker = _mk_broker_with_topic(event_loop)
+    router = broker.router
+    router.min_batch = 8
+    before = broker.metrics.router_fallback_msgs
+    routes, _, _ = router.route_pending("/", _entries([("ex", "a.b")] * 3))
+    assert broker.metrics.router_fallback_msgs == before + 3
+    assert sorted(q.name for q in routes[0]) == ["q1", "q2"]
+    assert broker.metrics.router_batches == 0
+
+
+def test_engine_verify_mode_clean(event_loop):
+    broker = _mk_broker_with_topic(event_loop)
+    router = broker.router
+    router.min_batch = 1
+    router.verify = True
+    router.route_pending(
+        "/", _entries([("ex", k) for k in ("a.b", "a.x", "q", "", "a.b.c")]))
+    assert broker.metrics.router_parity_mismatches == 0
+
+
+def test_engine_defer_ok_gates(event_loop):
+    broker = _mk_broker_with_topic(event_loop)
+    router = broker.router
+    assert router.defer_ok("/", "ex")
+    assert not router.defer_ok("/", "")           # default exchange
+    assert not router.defer_ok("/", "missing")    # no such exchange
+    event_loop.run_until_complete(
+        broker.declare_exchange("/", "alt-ex", "topic",
+                                arguments={"alternate-exchange": "ex"}))
+    assert not router.defer_ok("/", "alt-ex")     # alternate semantics
+    event_loop.run_until_complete(broker.declare_exchange("/", "e2", "fanout"))
+    assert router.defer_ok("/", "e2")
+    event_loop.run_until_complete(
+        broker.bind_exchange("/", "ex", "e2", "k"))
+    assert not router.defer_ok("/", "e2")         # e2e graph
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: deferred fused publishes through a live connection
+# ---------------------------------------------------------------------------
+
+pytest_plugins: list = []
+
+
+@pytest.fixture
+def server(event_loop):
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0)
+    event_loop.run_until_complete(srv.start())
+    yield srv
+    event_loop.run_until_complete(srv.stop())
+
+
+def test_deferred_publish_end_to_end(event_loop, server):
+    async def run():
+        c = await AMQPClient.connect("127.0.0.1", server.bound_port)
+        ch = await c.channel()
+        await ch.exchange_declare("ex", "topic")
+        await ch.queue_declare("q1")
+        await ch.queue_bind("q1", "ex", "a.*.c")
+        await ch.queue_bind("q1", "ex", "exact.key")
+        await ch.confirm_select()
+        for _ in range(100):
+            ch.basic_publish(b"m", exchange="ex", routing_key="a.b.c")
+        for _ in range(20):
+            ch.basic_publish(b"m", exchange="ex", routing_key="miss")
+        await ch.wait_unconfirmed_below(1)
+        await c.close()
+
+    event_loop.run_until_complete(run())
+    metrics = server.broker.metrics
+    assert metrics.router_batch_msgs >= 100
+    assert metrics.router_batches >= 1
+    assert metrics.router_parity_mismatches == 0
+    q1 = server.broker.vhosts["/"].queues["q1"]
+    assert q1.message_count == 100
+
+
+def test_deferred_publish_fifo_with_nondeferrable(event_loop, server):
+    """Deferred (topic) and non-deferrable (default-exchange) publishes on
+    one channel must land in queue order — the flush-before-publish rule."""
+    async def run():
+        c = await AMQPClient.connect("127.0.0.1", server.bound_port)
+        ch = await c.channel()
+        await ch.exchange_declare("ex", "topic")
+        await ch.queue_declare("q")
+        await ch.queue_bind("q", "ex", "k.*")
+        await ch.confirm_select()
+        for i in range(30):
+            if i % 3 == 2:
+                # default exchange: never deferred
+                ch.basic_publish(str(i).encode(), exchange="",
+                                 routing_key="q")
+            else:
+                ch.basic_publish(str(i).encode(), exchange="ex",
+                                 routing_key="k.x")
+        await ch.wait_unconfirmed_below(1)
+        got = []
+        while True:
+            msg = await ch.basic_get("q", no_ack=True)
+            if msg is None:
+                break
+            got.append(int(msg.body))
+        assert got == list(range(30))
+        await c.close()
+
+    event_loop.run_until_complete(run())
+
+
+def test_router_disabled_still_routes(event_loop):
+    async def run():
+        srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0)
+        await srv.start()
+        srv.broker.router = None  # runtime-off: inline publish_sync path
+        try:
+            c = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+            ch = await c.channel()
+            await ch.exchange_declare("ex", "topic")
+            await ch.queue_declare("q")
+            await ch.queue_bind("q", "ex", "a.#")
+            await ch.confirm_select()
+            for _ in range(25):
+                ch.basic_publish(b"m", exchange="ex", routing_key="a.b")
+            await ch.wait_unconfirmed_below(1)
+            assert srv.broker.vhosts["/"].queues["q"].message_count == 25
+            assert srv.broker.metrics.router_batches == 0
+            await c.close()
+        finally:
+            await srv.stop()
+
+    event_loop.run_until_complete(run())
