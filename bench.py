@@ -257,6 +257,148 @@ def _reap_children(children: list, consumers: int,
     return outputs, errors
 
 
+def _proc_cpu_s(pid: int) -> "float | None":
+    """Cumulative user+system CPU seconds of a process from
+    /proc/<pid>/stat. Sampled around the load window so boot cost (JAX
+    import is seconds) never pollutes the per-message CPU figure."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            data = f.read().decode("ascii", "replace")
+        # comm may contain spaces/parens; real fields start after the
+        # last ')': state is field 3, utime/stime are fields 14/15
+        fields = data.rpartition(")")[2].split()
+        ticks = int(fields[11]) + int(fields[12])
+        return ticks / os.sysconf("SC_CLK_TCK")
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# bench trajectory ledger + regression gate
+# ---------------------------------------------------------------------------
+
+TRAJECTORY_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_trajectory.jsonl")
+
+
+def _git_rev() -> "str | None":
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, timeout=10)
+        return out.stdout.decode().strip() or None
+    except Exception:
+        return None
+
+
+def _env_fingerprint() -> dict:
+    """What must match for two trajectory lines to be comparable: numbers
+    from a different core count, body size, run length, or parser
+    implementation are history, not baselines."""
+    from chanamq_tpu import native_ext
+
+    return {
+        "python": sys.version.split()[0],
+        "cores": os.cpu_count(),
+        "body_bytes": BODY_BYTES,
+        "seconds": BENCH_SECONDS,
+        "native": native_ext.available(),
+    }
+
+
+def trajectory_record(scenario: str, result: dict) -> "dict | None":
+    """Normalize one clean run_spec result into a trajectory line. The
+    headline cost is µs of wall per delivered message; cpu_us_per_msg is
+    the broker-process CPU ledger (far less noisy than wall on a shared
+    box, hence the tighter regression band on it)."""
+    delivered_per_s = result.get("delivered_per_s")
+    if not delivered_per_s:
+        return None
+    return {
+        "ts": round(time.time(), 1),
+        "scenario": scenario,
+        "us_per_msg": round(1e6 / delivered_per_s, 3),
+        "cpu_us_per_msg": result.get("cpu_us_per_msg"),
+        "delivered_per_s": delivered_per_s,
+        "p50_us": result.get("p50_us"),
+        "p99_us": result.get("p99_us"),
+        "rev": _git_rev(),
+        "env": _env_fingerprint(),
+    }
+
+
+def trajectory_append(record: dict) -> None:
+    with open(TRAJECTORY_PATH, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def trajectory_baseline(scenario: str,
+                        path: str = None) -> "dict | None":
+    """Latest recorded run of `scenario` from a comparable environment."""
+    env = _env_fingerprint()
+    latest = None
+    try:
+        with open(path or TRAJECTORY_PATH) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("scenario") != scenario:
+                    continue
+                rec_env = rec.get("env") or {}
+                if any(rec_env.get(k) != env[k]
+                       for k in ("cores", "body_bytes", "seconds",
+                                 "native")):
+                    continue
+                latest = rec
+    except OSError:
+        return None
+    return latest
+
+
+def regress_evaluate(current: dict, base: dict,
+                     wall_band: float = 0.20,
+                     cpu_band: float = 0.10) -> dict:
+    """Pure verdict on one scenario (unit-testable without a broker).
+
+    Regressed only when BOTH per-message costs exceed their noise band:
+    wall µs/msg past +20% (the ROADMAP's honest band for 5 s wall numbers
+    on a shared box) AND broker CPU µs/msg past +10% (CPU is steadier, so
+    the band is tighter). Requiring both keeps a CPU-steal burst in either
+    single run from failing the gate; a real regression moves both. Wall
+    alone decides when either side lacks the CPU ledger (old record)."""
+    cur_wall, base_wall = current.get("us_per_msg"), base.get("us_per_msg")
+    cur_cpu, base_cpu = (current.get("cpu_us_per_msg"),
+                         base.get("cpu_us_per_msg"))
+    wall_over = bool(cur_wall is not None and base_wall
+                     and cur_wall > base_wall * (1 + wall_band))
+    cpu_over = bool(cur_cpu is not None and base_cpu
+                    and cur_cpu > base_cpu * (1 + cpu_band))
+    if cur_cpu is None or not base_cpu:
+        regressed = wall_over
+    else:
+        regressed = wall_over and cpu_over
+    return {
+        "scenario": current.get("scenario"),
+        "us_per_msg": cur_wall,
+        "base_us_per_msg": base_wall,
+        "cpu_us_per_msg": cur_cpu,
+        "base_cpu_us_per_msg": base_cpu,
+        "wall_band_pct": round(wall_band * 100, 1),
+        "cpu_band_pct": round(cpu_band * 100, 1),
+        "wall_over": wall_over,
+        "cpu_over": cpu_over,
+        "base_rev": base.get("rev"),
+        "base_ts": base.get("ts"),
+        "regressed": regressed,
+    }
+
+
 def run_spec(name: str, rate: int = 0,
              extra_env: "dict | None" = None) -> dict:
     persistent = False
@@ -303,9 +445,13 @@ def run_spec(name: str, rate: int = 0,
     errors: list[str] = []
     outputs: list[dict] = []
     elapsed = 0.0
+    cpu0 = cpu1 = None
     try:
         wait_port(port)
         asyncio.run(setup_topology(port, persistent, exchange_type, queues))
+        # broker CPU around the load window only: boot (JAX import) and
+        # teardown are excluded from the per-message figure
+        cpu0 = _proc_cpu_s(broker.pid)
         queue_names = [q for q, _ in queues] if queues else ["bench_q"]
         for i in range(consumers):
             children.append(subprocess.Popen(
@@ -330,6 +476,7 @@ def run_spec(name: str, rate: int = 0,
         outputs.extend(outs)
         errors.extend(errs)
         elapsed = time.perf_counter() - t0
+        cpu1 = _proc_cpu_s(broker.pid)
     except Exception as exc:  # noqa: BLE001 — a red spec must stay parseable
         for child in children:
             if child.poll() is None:
@@ -373,6 +520,8 @@ def run_spec(name: str, rate: int = 0,
     delivered = sum(o.get("delivered", 0) for o in outputs)
     p99s = [o["p99_us"] for o in outputs if o.get("p99_us") is not None]
     p50s = [o["p50_us"] for o in outputs if o.get("p50_us") is not None]
+    broker_cpu_s = (round(cpu1 - cpu0, 3)
+                    if cpu0 is not None and cpu1 is not None else None)
     return {
         "published_per_s": round(published / BENCH_SECONDS, 1),
         "delivered_per_s": round(delivered / BENCH_SECONDS, 1),
@@ -381,6 +530,10 @@ def run_spec(name: str, rate: int = 0,
         "p50_us": round(max(p50s), 1) if p50s else None,
         "p99_us": round(max(p99s), 1) if p99s else None,
         "wall_s": round(elapsed, 2),
+        "broker_cpu_s": broker_cpu_s,
+        "cpu_us_per_msg": (round(broker_cpu_s * 1e6 / delivered, 2)
+                           if broker_cpu_s is not None and delivered
+                           else None),
     }
 
 
@@ -523,6 +676,16 @@ async def _admin_get(port: int, path: str) -> dict:
     raw = await asyncio.wait_for(reader.read(-1), 10)
     writer.close()
     return json.loads(raw.partition(b"\r\n\r\n")[2])
+
+
+async def _admin_text(port: int, path: str) -> str:
+    """Like _admin_get but for text/plain payloads (collapsed stacks)."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    raw = await asyncio.wait_for(reader.read(-1), 10)
+    writer.close()
+    return raw.partition(b"\r\n\r\n")[2].decode("utf-8", "replace")
 
 
 async def _trace_gate(admin_port: int, node_names: set) -> dict:
@@ -1351,6 +1514,159 @@ async def _route_groups_spec(groups: int, records: int) -> dict:
         await srv.stop()
 
 
+def run_overhead(metric: str, variants: "list[tuple]",
+                 budget_pct: "float | None" = None,
+                 value_label: "str | None" = None,
+                 extra_out: "dict | None" = None) -> None:
+    """Shared off-vs-on overhead harness for every observability subsystem
+    (--trace-overhead / --telemetry-overhead / --control-overhead /
+    --profile-overhead used to carry four copies of this logic).
+
+    `variants` is [(label, extra_env-or-None), ...]; the first is the
+    baseline. Reports each variant's throughput delta vs the baseline;
+    when `budget_pct` is set (e.g. -2.0), any variant losing more than
+    that fails the smoke (exit 1) — tier1.sh retries the whole comparison
+    because two independent 5 s runs carry +/-10% noise on a shared box.
+    Prints the one-line JSON and exits non-zero on error/over-budget."""
+    runs: dict = {}
+    for label, extra in variants:
+        runs[label] = run_spec("transient_autoack_3p3c", extra_env=extra)
+        print(f"# {metric} {label}: {runs[label]}", file=sys.stderr)
+    base_label = variants[0][0]
+    base = runs[base_label].get("delivered_per_s") or 0
+    deltas = {}
+    for label, _ in variants[1:]:
+        cur = runs[label].get("delivered_per_s")
+        deltas[label] = (round((cur - base) / base * 100, 2)
+                         if base and cur is not None else None)
+    errors = {k: v["error"] for k, v in runs.items() if "error" in v}
+    over_budget = budget_pct is not None and any(
+        d is not None and d < budget_pct for d in deltas.values())
+    value = deltas.get(value_label or variants[1][0])
+    print(json.dumps({
+        "metric": metric,
+        "value": value,
+        "unit": "%",
+        "vs_baseline": None,
+        "delta_pct": deltas,
+        "delivered_per_s": {
+            k: v.get("delivered_per_s") for k, v in runs.items()},
+        "cpu_us_per_msg": {
+            k: v.get("cpu_us_per_msg") for k, v in runs.items()},
+        "body_bytes": BODY_BYTES,
+        **({"budget_pct": budget_pct, "within_budget": not over_budget}
+           if budget_pct is not None else {}),
+        **(extra_out or {}),
+        **({"error": errors} if errors else {}),
+    }))
+    if errors or over_budget:
+        sys.exit(1)  # over-budget throughput loss fails the smoke
+
+
+def run_profile_smoke() -> dict:
+    """Attribution smoke: the headline workload against a broker booted
+    with the cost ledger + stack sampler on, scraping /admin/profile just
+    before and just after the load window. The stage/CPU deltas between
+    the two scrapes exclude boot and idle time, so the gate can demand
+    that the ledger's non-overlapping top-level windows account for >=90%
+    of the broker's measured process CPU, that at least 5 distinct stages
+    saw traffic, and that the collapsed-stack endpoint is non-empty."""
+    port = free_port()
+    admin_port = free_port()
+    env = {**os.environ,
+           "PYTHONPATH": os.path.dirname(os.path.abspath(__file__)),
+           "CHANAMQ_PROFILE_ENABLED": "true",
+           "CHANAMQ_PROFILE_SAMPLE_HZ": "67",
+           "CHANAMQ_PROFILE_SLOW_CALLBACK_MS": "250"}
+    broker_log = tempfile.NamedTemporaryFile(
+        suffix=".log", prefix="bench-profile-", delete=False)
+    broker = subprocess.Popen(
+        [sys.executable, "-m", "chanamq_tpu.broker.server",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--admin-port", str(admin_port), "--log-level", "WARNING"],
+        env=env, stdout=broker_log, stderr=broker_log)
+    children: list = []
+    try:
+        wait_port(port)
+        wait_port(admin_port)
+        asyncio.run(setup_topology(port, False))
+        snap0 = asyncio.run(_admin_get(admin_port, "/admin/profile"))
+        for _ in range(2):
+            children.append(subprocess.Popen(
+                [sys.executable, __file__, "--role", "consumer",
+                 "--port", str(port), "--auto-ack", "1",
+                 "--seconds", str(BENCH_SECONDS)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        time.sleep(0.3)
+        for _ in range(2):
+            children.append(subprocess.Popen(
+                [sys.executable, __file__, "--role", "producer",
+                 "--port", str(port), "--seconds", str(BENCH_SECONDS)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        outputs, errors = _reap_children(children, 2, BENCH_SECONDS + 60)
+        snap1 = asyncio.run(_admin_get(admin_port, "/admin/profile"))
+        stacks = asyncio.run(_admin_text(
+            admin_port, "/admin/profile/stacks"))
+    except Exception as exc:  # noqa: BLE001 — a red smoke must stay parseable
+        for child in children:
+            if child.poll() is None:
+                child.kill()
+            child.communicate()
+        return {"error": f"{type(exc).__name__}: {exc}",
+                "broker_stderr_tail": _tail(broker_log.name)[-800:]}
+    finally:
+        broker.terminate()
+        try:
+            broker.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            broker.kill()
+            broker.wait()
+        broker_log.close()
+        try:
+            os.unlink(broker_log.name)
+        except OSError:
+            pass
+    if errors:
+        return {"error": "; ".join(errors)}
+    delivered = sum(o.get("delivered", 0) for o in outputs)
+    stages = {}
+    for name, s1 in snap1["stages"].items():
+        s0 = snap0["stages"][name]
+        d_ns = s1["ns"] - s0["ns"]
+        d_calls = s1["calls"] - s0["calls"]
+        stages[name] = {
+            "ns": d_ns, "calls": d_calls,
+            "us_per_call": (round(d_ns / d_calls / 1000.0, 3)
+                            if d_calls else None),
+        }
+    busy_ns = snap1["busy_ns"] - snap0["busy_ns"]
+    # the honest denominator is the event-loop thread's CPU (steal-proof,
+    # excludes the sampler thread); older payloads only carry process CPU
+    loop_cpu_ns = (snap1["loop_cpu_ns"] - snap0["loop_cpu_ns"]
+                   if "loop_cpu_ns" in snap1
+                   else snap1["process_cpu_ns"] - snap0["process_cpu_ns"])
+    active = sorted(n for n, s in stages.items() if s["calls"] > 0)
+    stack_lines = [ln for ln in stacks.splitlines() if ln.strip()]
+    return {
+        "delivered": delivered,
+        "delivered_per_s": round(delivered / BENCH_SECONDS, 1),
+        "stages": stages,
+        "stages_active": active,
+        "busy_ns": busy_ns,
+        "loop_cpu_ns": loop_cpu_ns,
+        "process_cpu_ns": (snap1["process_cpu_ns"]
+                           - snap0["process_cpu_ns"]),
+        "attributed_pct": (round(busy_ns / loop_cpu_ns * 100, 1)
+                           if loop_cpu_ns > 0 else None),
+        "gc_pauses": snap1["gc"]["pauses"] - snap0["gc"]["pauses"],
+        "samples": (snap1["sampler"]["samples"]
+                    - snap0["sampler"]["samples"]),
+        "distinct_stacks": snap1["sampler"]["distinct_stacks"],
+        "stack_lines": len(stack_lines),
+        "slow_callbacks": snap1["slow_callbacks"]["count"],
+    }
+
+
 def main() -> None:
     if "--role" in sys.argv:
         import argparse
@@ -1659,39 +1975,14 @@ def main() -> None:
         # sees the control plane — gather is one loop callback, the
         # evaluation runs on its own executor — so the claim is the same
         # <= 2% budget the telemetry sampler is held to.
-        spec = "transient_autoack_3p3c"
         base_env = {"CHANAMQ_TELEMETRY_ENABLED": "true",
                     "CHANAMQ_TELEMETRY_INTERVAL": "100ms"}
-        runs = {}
-        for label, extra in (
+        run_overhead("control_overhead_pct", [
             ("off", dict(base_env)),
             ("on", {**base_env,
                     "CHANAMQ_CONTROL_ENABLED": "true",
                     "CHANAMQ_CONTROL_INTERVAL": "100ms"}),
-        ):
-            runs[label] = run_spec(spec, extra_env=extra)
-            print(f"# control_overhead {label}: {runs[label]}",
-                  file=sys.stderr)
-        base = runs["off"].get("delivered_per_s") or 0
-        cur = runs["on"].get("delivered_per_s")
-        delta = (round((cur - base) / base * 100, 2)
-                 if base and cur is not None else None)
-        errors = {k: v["error"] for k, v in runs.items() if "error" in v}
-        over_budget = delta is not None and delta < -2.0
-        print(json.dumps({
-            "metric": "control_overhead_pct",
-            "value": delta,
-            "unit": "%",
-            "vs_baseline": None,
-            "delivered_per_s": {
-                k: v.get("delivered_per_s") for k, v in runs.items()},
-            "body_bytes": BODY_BYTES,
-            "budget_pct": -2.0,
-            "within_budget": not over_budget,
-            **({"error": errors} if errors else {}),
-        }))
-        if errors or over_budget:
-            sys.exit(1)  # > 2% throughput loss fails the smoke
+        ], budget_pct=-2.0)
         return
 
     if "--control" in sys.argv:
@@ -1795,39 +2086,15 @@ def main() -> None:
         # three times — tracing off, the default 1% sample rate, and
         # everything-sampled — reporting the throughput delta vs off.
         # The broker is a subprocess, so tracing is switched via the
-        # CHANAMQ_* env overrides it reads at boot.
-        spec = "transient_autoack_3p3c"
-        runs: dict = {}
-        for rate_label, sample in (("off", None), ("r0.01", 0.01),
-                                   ("r1.0", 1.0)):
-            extra = None
-            if sample is not None:
-                extra = {"CHANAMQ_TRACE_ENABLED": "true",
-                         "CHANAMQ_TRACE_SAMPLE_RATE": str(sample)}
-            runs[rate_label] = run_spec(spec, extra_env=extra)
-            print(f"# trace_overhead {rate_label}: {runs[rate_label]}",
-                  file=sys.stderr)
-        base = runs["off"].get("delivered_per_s") or 0
-        deltas = {}
-        for label in ("r0.01", "r1.0"):
-            cur = runs[label].get("delivered_per_s")
-            deltas[label] = (round((cur - base) / base * 100, 2)
-                             if base and cur is not None else None)
-        errors = {k: v["error"] for k, v in runs.items() if "error" in v}
-        print(json.dumps({
-            "metric": "trace_overhead_pct_at_r0.01",
-            "value": deltas["r0.01"],
-            "unit": "%",
-            "vs_baseline": None,
-            "delta_pct": deltas,
-            "delivered_per_s": {
-                k: v.get("delivered_per_s") for k, v in runs.items()},
-            "body_bytes": BODY_BYTES,
-            "trace_overhead": runs,
-            **({"error": errors} if errors else {}),
-        }))
-        if errors:
-            sys.exit(1)
+        # CHANAMQ_* env overrides it reads at boot. No budget gate: the
+        # r1.0 run is expected to cost real throughput.
+        run_overhead("trace_overhead_pct_at_r0.01", [
+            ("off", None),
+            ("r0.01", {"CHANAMQ_TRACE_ENABLED": "true",
+                       "CHANAMQ_TRACE_SAMPLE_RATE": "0.01"}),
+            ("r1.0", {"CHANAMQ_TRACE_ENABLED": "true",
+                      "CHANAMQ_TRACE_SAMPLE_RATE": "1.0"}),
+        ], value_label="r0.01")
         return
 
     if "--telemetry-overhead" in sys.argv:
@@ -1836,36 +2103,124 @@ def main() -> None:
         # rate). The hot path only pays the incremental gauge/counter
         # bumps; the sampler walk runs on the timer — the claim is a
         # <= 2% throughput delta, asserted here so tier-1 gates on it.
-        spec = "transient_autoack_3p3c"
-        runs = {}
-        for label, extra in (
+        run_overhead("telemetry_overhead_pct", [
             ("off", None),
             ("on", {"CHANAMQ_TELEMETRY_ENABLED": "true",
                     "CHANAMQ_TELEMETRY_INTERVAL": "100ms"}),
-        ):
-            runs[label] = run_spec(spec, extra_env=extra)
-            print(f"# telemetry_overhead {label}: {runs[label]}",
-                  file=sys.stderr)
-        base = runs["off"].get("delivered_per_s") or 0
-        cur = runs["on"].get("delivered_per_s")
-        delta = (round((cur - base) / base * 100, 2)
-                 if base and cur is not None else None)
-        errors = {k: v["error"] for k, v in runs.items() if "error" in v}
-        over_budget = delta is not None and delta < -2.0
+        ], budget_pct=-2.0)
+        return
+
+    if "--profile-overhead" in sys.argv:
+        # cost-ledger cost: the headline spec with the profiler off vs on
+        # (ledger + watchdog armed, stack sampler off — the production
+        # always-on configuration). Every seam accumulates at batch
+        # granularity precisely so this delta stays inside the same <= 2%
+        # budget the other observability subsystems are held to.
+        run_overhead("profile_overhead_pct", [
+            ("off", None),
+            ("on", {"CHANAMQ_PROFILE_ENABLED": "true",
+                    "CHANAMQ_PROFILE_SAMPLE_HZ": "0"}),
+        ], budget_pct=-2.0)
+        return
+
+    if "--profile" in sys.argv:
+        # attribution smoke: ledger + sampler on, /admin/profile scraped
+        # around the load window — gates on >=5 stages with traffic,
+        # >=90% of broker CPU attributed to the top-level windows, and a
+        # non-empty collapsed-stack payload
+        result = run_profile_smoke()
+        print(f"# profile: {result}", file=sys.stderr)
+        active = result.get("stages_active") or []
+        attributed = result.get("attributed_pct")
+        failures = []
+        if "error" in result:
+            failures.append(result["error"])
+        else:
+            if len(active) < 5:
+                failures.append(f"only {len(active)} stages saw traffic")
+            if attributed is None or attributed < 90.0:
+                failures.append(
+                    f"attribution {attributed}% below the 90% gate")
+            if not result.get("stack_lines"):
+                failures.append("empty collapsed-stack payload")
         print(json.dumps({
-            "metric": "telemetry_overhead_pct",
-            "value": delta,
+            "metric": "profile_attributed_cpu_pct",
+            "value": attributed,
             "unit": "%",
             "vs_baseline": None,
-            "delivered_per_s": {
-                k: v.get("delivered_per_s") for k, v in runs.items()},
-            "body_bytes": BODY_BYTES,
-            "budget_pct": -2.0,
-            "within_budget": not over_budget,
-            **({"error": errors} if errors else {}),
+            "stages_active": active,
+            "delivered_per_s": result.get("delivered_per_s"),
+            "distinct_stacks": result.get("distinct_stacks"),
+            "stack_lines": result.get("stack_lines"),
+            "gc_pauses": result.get("gc_pauses"),
+            "profile": result,
+            **({"error": "; ".join(failures)} if failures else {}),
         }))
-        if errors or over_budget:
-            sys.exit(1)  # > 2% throughput loss fails the smoke
+        if failures:
+            sys.exit(1)  # the tier-1 smoke must fail loudly
+        return
+
+    if "--regress" in sys.argv:
+        # bench-trajectory regression gate: best-of-N of the headline spec
+        # vs the latest comparable line in BENCH_trajectory.jsonl. Never
+        # appends unless --record is given (or no baseline exists yet), so
+        # two consecutive --regress runs judge against the SAME baseline.
+        record = "--record" in sys.argv
+        scenario = os.environ.get("BENCH_REGRESS_SPEC",
+                                  "transient_autoack_3p3c")
+        attempts = max(1, int(os.environ.get("BENCH_REGRESS_RUNS", "2")))
+        best = None
+        run_errors = []
+        for i in range(attempts):
+            run = run_spec(scenario)
+            print(f"# regress run {i + 1}/{attempts}: {run}",
+                  file=sys.stderr)
+            if "error" in run:
+                run_errors.append(run["error"])
+                continue
+            rec = trajectory_record(scenario, run)
+            if rec is not None and (
+                    best is None or rec["us_per_msg"] < best["us_per_msg"]):
+                best = rec
+        if best is None:
+            print(json.dumps({
+                "metric": "bench_regress_us_per_msg", "value": None,
+                "unit": "us/msg", "vs_baseline": None,
+                "scenario": scenario,
+                "error": "; ".join(run_errors) or "no clean run"}))
+            sys.exit(1)
+        base = trajectory_baseline(scenario)
+        if base is None:
+            # first run in this environment: seed the trajectory so the
+            # next invocation has a baseline — nothing to gate against
+            trajectory_append(best)
+            print(json.dumps({
+                "metric": "bench_regress_us_per_msg",
+                "value": best["us_per_msg"],
+                "unit": "us/msg", "vs_baseline": None,
+                "scenario": scenario, "seeded": True,
+                "cpu_us_per_msg": best["cpu_us_per_msg"],
+                "trajectory": TRAJECTORY_PATH,
+            }))
+            return
+        verdict = regress_evaluate(best, base)
+        if record:
+            trajectory_append(best)
+        print(json.dumps({
+            "metric": "bench_regress_us_per_msg",
+            "value": best["us_per_msg"],
+            "unit": "us/msg",
+            "vs_baseline": round(
+                (best["us_per_msg"] - base["us_per_msg"])
+                / base["us_per_msg"] * 100, 2) if base.get("us_per_msg")
+                else None,
+            "scenario": scenario,
+            "recorded": record,
+            "trajectory": TRAJECTORY_PATH,
+            **verdict,
+        }))
+        if verdict["regressed"]:
+            sys.exit(1)  # a confirmed wall+CPU regression fails the gate
         return
 
     if "--replicate" in sys.argv:
@@ -1929,6 +2284,15 @@ def main() -> None:
     if which == "all":
         cluster = run_cluster_spec()
         print(f"# cluster_2node: {cluster}", file=sys.stderr)
+    # every clean spec run extends the bench trajectory, so the numbers
+    # quoted in BENCH.md/README always have a recorded provenance line
+    # and `bench.py --regress` has baselines to gate against
+    if os.environ.get("BENCH_TRAJECTORY", "1") != "0":
+        for name, result in results.items():
+            if "error" not in result:
+                rec = trajectory_record(name, result)
+                if rec is not None:
+                    trajectory_append(rec)
     line = {
         "metric": "amqp_delivered_msgs_per_s_transient_autoack_3p3c",
         "value": headline.get("delivered_per_s"),

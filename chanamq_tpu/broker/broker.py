@@ -20,7 +20,7 @@ import logging
 import time
 from typing import Any, Awaitable, Optional
 
-from .. import chaos, trace
+from .. import chaos, profile, trace
 
 from ..amqp.constants import ErrorCode, ExchangeType
 from ..amqp.properties import BasicProperties
@@ -109,6 +109,9 @@ class Broker:
         # set by chanamq_tpu.control.ControlService when the predictive
         # control plane is on (chana.mq.control.enabled)
         self.control = None
+        # set by chanamq_tpu.profile.enable_from_config when the cost
+        # ledger is on (chana.mq.profile.enabled); admin serves its snapshot
+        self.profile = None
         # broker-wide entity gauges, maintained incrementally at every queue
         # mutation site (entities.py / streams/queue.py) so a sampler tick is
         # O(1) instead of a walk over every queue in every vhost
@@ -285,6 +288,8 @@ class Broker:
         connection flushes before every await)."""
         routes, t0, t1 = self.router.route_pending(vhost_name, entries)
         metrics = self.metrics
+        prof = profile.ACTIVE
+        t_enq = time.perf_counter_ns() if prof is not None else 0
         for entry, queues in zip(entries, routes):
             exchange, routing_key, props, body, header, exrk, confirmed = entry
             metrics.published(len(body))
@@ -297,6 +302,16 @@ class Broker:
             self._publish_local(
                 queues, exchange, routing_key, props, body, False,
                 header, confirm_marks if confirmed else None, exrk)
+        if prof is not None:
+            # batch-granular ledger: one accumulate covers the whole flush
+            # (route window from the router, enqueue from the loop above),
+            # with calls counting messages so ns/calls reads as us/msg
+            n = len(entries)
+            sns, sc = prof.stage_ns, prof.stage_calls
+            sns[profile.ROUTE] += t1 - t0
+            sc[profile.ROUTE] += n
+            sns[profile.ENQUEUE] += time.perf_counter_ns() - t_enq
+            sc[profile.ENQUEUE] += n
 
     def spawn(self, coro: Awaitable) -> None:
         """Fire-and-forget a coroutine with a strong reference held until
@@ -1406,6 +1421,8 @@ class Broker:
             tr = trace.ACTIVE.begin_publish(self.trace_node)
             if tr is not None:
                 t_route = time.perf_counter_ns()
+        prof = profile.ACTIVE
+        t_prof = time.perf_counter_ns() if prof is not None else 0
         cache = self._route_cache
         if cache is not None:
             key = (vhost_name, exchange_name, routing_key)
@@ -1416,6 +1433,11 @@ class Broker:
                 if tr is not None:
                     tr.span(trace.ROUTE, t_route, time.perf_counter_ns(),
                             self.trace_node)
+                if prof is not None:
+                    return self._publish_local_profiled(
+                        prof, t_prof, queues, exchange_name, routing_key,
+                        properties, body, immediate, header_raw, marks,
+                        exrk_raw)
                 return self._publish_local(
                     queues, exchange_name, routing_key, properties,
                     body, immediate, header_raw, marks, exrk_raw)
@@ -1441,9 +1463,32 @@ class Broker:
         if tr is not None:
             tr.span(trace.ROUTE, t_route, time.perf_counter_ns(),
                     self.trace_node)
+        if prof is not None:
+            return self._publish_local_profiled(
+                prof, t_prof, queues, exchange_name, routing_key,
+                properties, body, immediate, header_raw, marks, exrk_raw)
         return self._publish_local(
             queues, exchange_name, routing_key, properties,
             body, immediate, header_raw, marks, exrk_raw)
+
+    def _publish_local_profiled(
+        self, prof, t0: int, queues, exchange_name, routing_key,
+        properties, body, immediate, header_raw, marks, exrk_raw,
+    ) -> tuple[bool, bool]:
+        """publish_sync tail with the cost ledger armed: t0 (taken before
+        the route lookup) to here is ROUTE, the _publish_local call is
+        ENQUEUE. Split out so the disabled path pays nothing but the
+        ACTIVE check."""
+        t1 = time.perf_counter_ns()
+        out = self._publish_local(
+            queues, exchange_name, routing_key, properties,
+            body, immediate, header_raw, marks, exrk_raw)
+        sns, sc = prof.stage_ns, prof.stage_calls
+        sns[profile.ROUTE] += t1 - t0
+        sc[profile.ROUTE] += 1
+        sns[profile.ENQUEUE] += time.perf_counter_ns() - t1
+        sc[profile.ENQUEUE] += 1
+        return out
 
     def cluster_route_cached(
         self, vhost_name: str, exchange_name: str, routing_key: str,

@@ -43,7 +43,7 @@ from ..amqp.constants import (
 from ..amqp.frame import Frame, FrameError, FrameParser, HEARTBEAT_BYTES
 from ..amqp import methods as am
 from ..amqp.properties import BasicProperties
-from .. import trace
+from .. import profile, trace
 from .broker import Broker, BrokerError
 from .channel import ChannelMode, Consumer, ServerChannel
 from ..flow import STAGE_THROTTLE
@@ -506,6 +506,12 @@ class AMQPConnection:
             t1 = time.perf_counter_ns()
             self.broker.metrics.flow_hold_releases += 1
             self.broker.metrics.flow_hold_wait_ns += t1 - t0
+            prof = profile.ACTIVE
+            if prof is not None:
+                # wall, not CPU: how long the gate parked this stream —
+                # one accumulate per throttle episode, already-stamped
+                prof.stage_ns[profile.FLOW_THROTTLE] += t1 - t0
+                prof.stage_calls[profile.FLOW_THROTTLE] += 1
             if trace.ACTIVE is not None:
                 # the first released publish carries the flow-throttle span
                 # (how long the gate parked this connection's stream)
@@ -596,13 +602,38 @@ class AMQPConnection:
                     continue
             else:
                 data = await self._read_chunk()
+            # one ingress-cycle ledger window per read chunk: parse walk,
+            # fused publishes, command dispatch, and the batch barrier all
+            # run inside it (two stamps per ~256 KiB chunk, not per
+            # message) — this is the top-level "where did the loop's CPU
+            # go" stage the finer route/enqueue stages nest within. The
+            # window is loop-thread CPU, and any OTHER top-level window
+            # that accumulated while this coroutine was suspended (a
+            # dispatch pass, a sibling connection's cycle) is subtracted
+            # back out so the top-level sum never double-counts.
+            prof = profile.ACTIVE
+            if prof is not None:
+                sns = prof.stage_ns
+                t_cycle = time.thread_time_ns()
+                nested0 = int(sns[profile.DISPATCH]
+                              + sns[profile.CLUSTER_PUSH]
+                              + sns[profile.INGRESS_CYCLE])
             if scan is not None:
-                if not await self._consume_scan(scan(data)):
-                    return
+                ok = await self._consume_scan(scan(data))
             else:
-                if not await self._consume_feed(self._parser.feed(data)):
-                    return
-            await self._batch_barrier()
+                ok = await self._consume_feed(self._parser.feed(data))
+            if ok:
+                await self._batch_barrier()
+            if prof is not None:
+                dt = time.thread_time_ns() - t_cycle
+                nested = int(sns[profile.DISPATCH]
+                             + sns[profile.CLUSTER_PUSH]
+                             + sns[profile.INGRESS_CYCLE]) - nested0
+                if dt > nested:
+                    sns[profile.INGRESS_CYCLE] += dt - nested
+                prof.stage_calls[profile.INGRESS_CYCLE] += 1
+            if not ok:
+                return
 
     async def _run_command(self, out: AMQCommand) -> bool:
         """Dispatch one assembled command with the connection's error

@@ -31,7 +31,7 @@ import time
 from collections import deque
 from typing import TYPE_CHECKING, Any, Callable, Optional
 
-from .. import trace
+from .. import profile, trace
 from ..amqp.properties import BasicProperties
 from ..store.api import StoredMessage
 from .matchers import Matcher, matcher_for
@@ -619,6 +619,19 @@ class Queue:
         self._dispatch_scheduled = False
         if self.deleted:
             return
+        # dispatch-pass ledger window: two stamps per coalesced pass, not
+        # per delivery. The pass is ~all delivery rendering, so the same
+        # window feeds both the top-level "dispatch" stage (calls=passes,
+        # thread-CPU so the attribution busy-sum stays steal-proof) and
+        # the fine "deliver" stage (calls=messages, so ns/calls reads
+        # as us per delivered message). The pass is synchronous, so no
+        # other ledger window can interleave inside it.
+        prof = profile.ACTIVE
+        t_pass = 0
+        n_before = 0
+        if prof is not None:
+            t_pass = time.thread_time_ns()
+            n_before = self.n_delivered
         new_unacks: list[tuple[int, int, int, Optional[int]]] = []
         messages = self.messages
         while messages and self.consumers:
@@ -665,6 +678,15 @@ class Queue:
             if self.repl is not None:
                 self.repl.append(
                     "unacks", {"rows": [list(r) for r in new_unacks]})
+        if prof is not None:
+            dt = time.thread_time_ns() - t_pass
+            sns, sc = prof.stage_ns, prof.stage_calls
+            sns[profile.DISPATCH] += dt
+            sc[profile.DISPATCH] += 1
+            delivered = self.n_delivered - n_before
+            if delivered:
+                sns[profile.DELIVER] += dt
+                sc[profile.DELIVER] += delivered
 
     # -- passivation / hydration -------------------------------------------
 
@@ -889,6 +911,8 @@ class Queue:
                 asyncio.get_event_loop().call_soon(self._flush_unack_deletes)
 
     def ack(self, delivery: Delivery) -> None:
+        prof = profile.ACTIVE
+        t_settle = time.perf_counter_ns() if prof is not None else 0
         self._settle_store(delivery)
         self.n_acked += 1
         if trace.ACTIVE is not None:
@@ -896,6 +920,10 @@ class Queue:
             if tr is not None:
                 trace.ACTIVE.on_settle(tr, self.broker.trace_node)
         self.broker.unrefer(delivery.queued.message)
+        if prof is not None:
+            prof.stage_ns[profile.SETTLE] += (
+                time.perf_counter_ns() - t_settle)
+            prof.stage_calls[profile.SETTLE] += 1
 
     def _flush_unack_deletes(self) -> None:
         ids, self._unack_del_buf = self._unack_del_buf, []
@@ -909,12 +937,18 @@ class Queue:
     def drop(self, delivery: Delivery) -> None:
         """Reject without requeue: same store cleanup as ack, then the
         message dead-letters (reason "rejected") when a DLX is set."""
+        prof = profile.ACTIVE
+        t_settle = time.perf_counter_ns() if prof is not None else 0
         self._settle_store(delivery)
         if trace.ACTIVE is not None:
             tr = delivery.queued.message.trace
             if tr is not None:
                 trace.ACTIVE.on_settle(tr, self.broker.trace_node)
         self._settle_dead(delivery.queued, "rejected")
+        if prof is not None:
+            prof.stage_ns[profile.SETTLE] += (
+                time.perf_counter_ns() - t_settle)
+            prof.stage_calls[profile.SETTLE] += 1
 
     def requeue(self, delivery: Delivery) -> None:
         """Return an unacked message to the queue, in offset order, marked

@@ -436,6 +436,13 @@ async def run_node(config) -> None:
             from .. import trace as trace_mod
 
             trace_mod.enable_from_config(config, server.broker)
+        # cost ledger + sampling profiler (third ACTIVE-gate subsystem):
+        # armed before traffic so stage counters cover the whole run, and
+        # before the cluster so cluster-push batches are attributed
+        if config.bool("chana.mq.profile.enabled"):
+            from .. import profile as profile_mod
+
+            profile_mod.enable_from_config(config, server.broker)
         if config.bool("chana.mq.cluster.enabled"):
             from ..cluster.node import ClusterNode
 

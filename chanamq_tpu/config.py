@@ -334,6 +334,23 @@ DEFAULTS: dict[str, Any] = {
     # the oracle on mismatch (router_parity_mismatches counts them) —
     # a debugging net, not for production throughput
     "chana.mq.router.verify": False,
+    # continuous profiling (chanamq_tpu/profile/): disabled by default —
+    # every hot-path seam stays a module-level `ACTIVE is None` check.
+    # Enabled, the per-message cost ledger accumulates per-stage CPU-ns
+    # into fixed numpy vectors (batch-granular on the batched paths) and
+    # serves GET /admin/profile + profile_stage_* Prometheus series
+    "chana.mq.profile.enabled": False,
+    # stack-sampling rate for the folded-stack profiler thread
+    # (GET /admin/profile/stacks); 0 = sampler off, watchdog only
+    "chana.mq.profile.sample-hz": 0,
+    # event-loop callbacks stalling the loop longer than this are captured
+    # (stack + duration) into the slow-callback ring, logged as structured
+    # JSON, and counted in profile_slow_callbacks_total; 0 = watchdog off
+    "chana.mq.profile.slow-callback-ms": 100,
+    # bounded ring of recent slow-callback captures kept for /admin/profile
+    "chana.mq.profile.ring-size": 64,
+    # attribute collector pauses via gc.callbacks (the "gc" ledger stage)
+    "chana.mq.profile.gc": True,
 }
 
 _DURATION_RE = re.compile(r"^\s*([0-9.]+)\s*(ms|s|m|h|d)?\s*$")

@@ -18,8 +18,10 @@ import glob
 import logging
 import os
 import subprocess
+import time
 from typing import Iterator, Optional
 
+from . import profile
 from .amqp.constants import ErrorCode
 from .amqp.frame import Frame, FrameError
 from .broker.matchers import Matcher
@@ -158,11 +160,20 @@ class NativeFrameParser:
         else:
             raw = bytes(data)
         while True:
+            # batch-granular cost ledger: one stamp pair per scan pass (up
+            # to _MAX_FRAMES_PER_SCAN frames), accumulated inside the lazy
+            # generator so the native call itself is what gets timed
+            prof = profile.ACTIVE
+            t_prof = time.perf_counter_ns() if prof is not None else 0
             n = self._lib.chana_scan_frames(
                 raw, len(raw), self.frame_max,
                 self._types, self._channels, self._offsets, self._lengths,
                 _MAX_FRAMES_PER_SCAN, ctypes.byref(self._consumed),
                 ctypes.byref(self._error))
+            if prof is not None and n:
+                prof.stage_ns[profile.INGRESS_PARSE] += (
+                    time.perf_counter_ns() - t_prof)
+                prof.stage_calls[profile.INGRESS_PARSE] += n
             if n:
                 yield (raw, n, self._types, self._channels,
                        self._offsets, self._lengths)

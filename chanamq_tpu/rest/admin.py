@@ -224,6 +224,12 @@ class AdminServer:
                 rest[2], rest[3], query))
         if len(rest) == 3 and rest[:2] == ["timeseries", "connection"]:
             return ("GET", lambda: self._timeseries_conn(rest[2], query))
+        if rest == ["profile"]:
+            return ("GET", self._profile)
+        if rest == ["profile", "stacks"]:
+            return ("GET", self._profile_stacks)
+        if len(rest) == 3 and rest[:2] == ["profile", "stage"]:
+            return ("GET", lambda: self._profile_stage(rest[2]))
         if rest == ["health"]:
             return ("GET", lambda: self._health(query))
         if rest == ["health", "live"]:
@@ -518,6 +524,38 @@ class AdminServer:
                     "prefetch": control.prefetch_enabled,
                 }}
 
+    # -- continuous profiling (chanamq_tpu/profile/) ------------------------
+
+    def _profsvc(self):
+        prof = getattr(self.broker, "profile", None)
+        if prof is None:
+            raise AdminError(
+                "409 Conflict",
+                "profiling disabled: boot with chana.mq.profile.enabled")
+        return prof
+
+    def _profile(self) -> dict:
+        """Cost-ledger aggregate: µs/msg by stage and subsystem, loop busy
+        time vs process CPU (attribution ratio), GC pauses, slow-callback
+        captures."""
+        return self._profsvc().snapshot()
+
+    def _profile_stacks(self) -> str:
+        """Folded stacks in flamegraph collapsed format (text/plain, one
+        ``stack count`` per line) — pipe straight into flamegraph.pl."""
+        prof = self._profsvc()
+        if prof.sample_hz <= 0:
+            raise AdminError(
+                "409 Conflict",
+                "stack sampler disabled: set chana.mq.profile.sample-hz")
+        return prof.collapsed()
+
+    def _profile_stage(self, name: str) -> dict:
+        detail = self._profsvc().stage_detail(name)
+        if detail is None:
+            raise AdminError("404 Not Found", f"unknown stage {name!r}")
+        return detail
+
     # metric name -> prometheus type; everything else in the snapshot is a
     # gauge. Latency percentiles remain exported as computed gauges for
     # dashboards that predate the proper histogram series; every Histogram
@@ -552,6 +590,8 @@ class AdminServer:
         "lifecycle_stale_holders_cleared",
         "router_batches", "router_batch_msgs", "router_compiles",
         "router_fallback_msgs", "router_parity_mismatches",
+        "profile_samples_total", "profile_slow_callbacks_total",
+        "profile_gc_pauses_total", "profile_gc_pause_ns_total",
     })
 
     @staticmethod
@@ -592,6 +632,22 @@ class AdminServer:
                 f'chanamq_{name}_bucket{{le="+Inf"}} {hist.count}')
             out.append(f"chanamq_{name}_sum {hist.total_us}")
             out.append(f"chanamq_{name}_count {hist.count}")
+        prof = getattr(self.broker, "profile", None)
+        if prof is not None:
+            # cost-ledger stage series, labeled by stage name so a single
+            # PromQL expression yields µs/msg: rate(stage_ns)/rate(calls)
+            from .. import profile as profile_mod
+
+            out.append("# TYPE chanamq_profile_stage_ns_total counter")
+            out.append("# TYPE chanamq_profile_stage_calls_total counter")
+            for i, stage in enumerate(profile_mod.STAGES):
+                labels = f'{{stage="{self._prom_label(stage)}"}}'
+                out.append(
+                    f"chanamq_profile_stage_ns_total{labels} "
+                    f"{int(prof.stage_ns[i])}")
+                out.append(
+                    f"chanamq_profile_stage_calls_total{labels} "
+                    f"{int(prof.stage_calls[i])}")
         out.append("# TYPE chanamq_queue_messages gauge")
         out.append("# TYPE chanamq_queue_ready_bytes gauge")
         out.append("# TYPE chanamq_queue_unacked gauge")

@@ -43,6 +43,12 @@ class JsonLogFormatter(logging.Formatter):
         tid = trace.current_trace_id()
         if tid is not None:
             out["trace"] = tid
+        # structured payloads: callers attach machine-readable fields via
+        # `log.warning(..., extra={"data": {...}})` (e.g. the profiler's
+        # slow-callback captures ship duration + folded stack this way)
+        data = getattr(record, "data", None)
+        if isinstance(data, dict):
+            out.update(data)
         if record.exc_info and record.exc_info[0] is not None:
             out["exc"] = self.formatException(record.exc_info)
         return json.dumps(out, ensure_ascii=False)

@@ -216,6 +216,16 @@ class Metrics:
         self.router_fallback_msgs = 0
         self.router_parity_mismatches = 0
         self.router_batch_size = Histogram()
+        # continuous profiling (chanamq_tpu/profile/): stack-sampler
+        # samples taken, event-loop callbacks caught over the slow
+        # threshold, and collector pauses seen by the gc hook. All zero
+        # unless chana.mq.profile.enabled. The _total suffix is baked
+        # into the attribute so the Prometheus series follow the naming
+        # convention for counters that grew up after PR 6.
+        self.profile_samples_total = 0
+        self.profile_slow_callbacks_total = 0
+        self.profile_gc_pauses_total = 0
+        self.profile_gc_pause_ns_total = 0
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -368,6 +378,10 @@ class Metrics:
             "router_batch_size_p50": self.router_batch_size.percentile_us(0.50),
             "router_batch_size_p99": self.router_batch_size.percentile_us(0.99),
             "router_batch_size_mean": self.router_batch_size.mean_us,
+            "profile_samples_total": self.profile_samples_total,
+            "profile_slow_callbacks_total": self.profile_slow_callbacks_total,
+            "profile_gc_pauses_total": self.profile_gc_pauses_total,
+            "profile_gc_pause_ns_total": self.profile_gc_pause_ns_total,
         }
         for key, hist in self.trace_stage_us.items():
             base = key[:-3] if key.endswith("_us") else key
