@@ -334,10 +334,16 @@ def trajectory_append(record: dict) -> None:
 
 
 def trajectory_baseline(scenario: str,
-                        path: str = None) -> "dict | None":
-    """Latest recorded run of `scenario` from a comparable environment."""
+                        path: str = None,
+                        stats: "dict | None" = None) -> "dict | None":
+    """Latest recorded run of `scenario` from a comparable environment.
+
+    When `stats` is given, stats["corrupt_lines"] counts unparseable
+    lines skipped along the way — a half-written append from a killed
+    run must not silently shrink the judged history."""
     env = _env_fingerprint()
     latest = None
+    corrupt = 0
     try:
         with open(path or TRAJECTORY_PATH) as f:
             for line in f:
@@ -347,6 +353,7 @@ def trajectory_baseline(scenario: str,
                 try:
                     rec = json.loads(line)
                 except ValueError:
+                    corrupt += 1
                     continue
                 if rec.get("scenario") != scenario:
                     continue
@@ -357,7 +364,11 @@ def trajectory_baseline(scenario: str,
                     continue
                 latest = rec
     except OSError:
+        if stats is not None:
+            stats["corrupt_lines"] = corrupt
         return None
+    if stats is not None:
+        stats["corrupt_lines"] = corrupt
     return latest
 
 
@@ -2123,6 +2134,34 @@ def main() -> None:
         ], budget_pct=-2.0)
         return
 
+    if "--slo-overhead" in sys.argv:
+        # SLO-engine cost: telemetry on in BOTH variants (at the same
+        # 100 ms tick --telemetry-overhead uses) so the delta isolates
+        # what the SLO layer adds per tick — the SLI sampler's counter
+        # deltas plus the burn-rate ring update, a few hundred integer
+        # ops. Held to the same <= 2% budget as every observability
+        # subsystem.
+        run_overhead("slo_overhead_pct", [
+            ("telemetry", {"CHANAMQ_TELEMETRY_ENABLED": "true",
+                           "CHANAMQ_TELEMETRY_INTERVAL": "100ms"}),
+            ("telemetry+slo", {"CHANAMQ_TELEMETRY_ENABLED": "true",
+                               "CHANAMQ_TELEMETRY_INTERVAL": "100ms",
+                               "CHANAMQ_SLO_ENABLED": "true"}),
+        ], budget_pct=-2.0)
+        return
+
+    if "--event-overhead" in sys.argv:
+        # event-bus + firehose cost with nothing bound — the always-on
+        # production configuration. Every emit is an O(1) topic-trie
+        # miss and a drop-counter bump; every publish/deliver pays one
+        # tap call that routes to zero queues. <= 2% budget.
+        run_overhead("event_overhead_pct", [
+            ("off", None),
+            ("on", {"CHANAMQ_EVENTS_ENABLED": "true",
+                    "CHANAMQ_FIREHOSE_ENABLED": "true"}),
+        ], budget_pct=-2.0)
+        return
+
     if "--profile" in sys.argv:
         # attribution smoke: ledger + sampler on, /admin/profile scraped
         # around the load window — gates on >=5 stages with traffic,
@@ -2189,7 +2228,12 @@ def main() -> None:
                 "scenario": scenario,
                 "error": "; ".join(run_errors) or "no clean run"}))
             sys.exit(1)
-        base = trajectory_baseline(scenario)
+        traj_stats: dict = {}
+        base = trajectory_baseline(scenario, stats=traj_stats)
+        corrupt = traj_stats.get("corrupt_lines", 0)
+        if corrupt:
+            print(f"# regress: skipped {corrupt} corrupt trajectory "
+                  f"line(s) in {TRAJECTORY_PATH}", file=sys.stderr)
         if base is None:
             # first run in this environment: seed the trajectory so the
             # next invocation has a baseline — nothing to gate against
@@ -2201,9 +2245,18 @@ def main() -> None:
                 "scenario": scenario, "seeded": True,
                 "cpu_us_per_msg": best["cpu_us_per_msg"],
                 "trajectory": TRAJECTORY_PATH,
+                "corrupt_lines_skipped": corrupt,
             }))
             return
         verdict = regress_evaluate(best, base)
+        # the judged-against baseline, stated in full: without the rev +
+        # fingerprint a red gate can't be traced back to the run that
+        # set the bar
+        print(f"# regress baseline: rev={base.get('rev')} "
+              f"ts={base.get('ts')} env={base.get('env')} "
+              f"us_per_msg={base.get('us_per_msg')} "
+              f"cpu_us_per_msg={base.get('cpu_us_per_msg')}",
+              file=sys.stderr)
         if record:
             trajectory_append(best)
         print(json.dumps({
@@ -2217,6 +2270,8 @@ def main() -> None:
             "scenario": scenario,
             "recorded": record,
             "trajectory": TRAJECTORY_PATH,
+            "corrupt_lines_skipped": corrupt,
+            "base_env": base.get("env"),
             **verdict,
         }))
         if verdict["regressed"]:

@@ -20,7 +20,7 @@ import logging
 import time
 from typing import Any, Awaitable, Optional
 
-from .. import chaos, profile, trace
+from .. import chaos, events, profile, trace
 
 from ..amqp.constants import ErrorCode, ExchangeType
 from ..amqp.properties import BasicProperties
@@ -373,6 +373,14 @@ class Broker:
                 listener(old, new)
             except Exception:
                 log.exception("flow stage listener failed")
+        bus = events.ACTIVE
+        if bus is not None:
+            flow = self.flow
+            bus.emit(f"flow.stage.{new}", {
+                "old": old, "new": new,
+                "stage": flow.label if flow is not None else str(new),
+                "total_bytes": flow.total if flow is not None else 0,
+            })
 
     def _update_gate(self) -> None:
         """Recompute the publisher gate from its component watermarks
@@ -746,6 +754,9 @@ class Broker:
             if self.cluster is not None:
                 self.cluster.broadcast_bg(
                     "meta.apply", {"kind": "vhost.created", "vhost": name})
+            fh = events.FIREHOSE
+            if fh is not None:
+                fh.refresh()  # a firehose targeting this vhost can now tap
         return vhost
 
     async def delete_vhost(self, name: str) -> bool:
@@ -760,6 +771,9 @@ class Broker:
         if self.cluster is not None:
             self.cluster.broadcast_bg(
                 "meta.apply", {"kind": "vhost.deleted", "vhost": name})
+        fh = events.FIREHOSE
+        if fh is not None:
+            fh.refresh()  # drop the deleted vhost's cached binding table
         return True
 
     # -- exchanges ---------------------------------------------------------
@@ -919,6 +933,13 @@ class Broker:
                 "durable": durable, "auto_delete": auto_delete,
                 "ttl_ms": ttl_ms, "arguments": arguments,
                 "holder": self.cluster.name, "epoch": epoch,
+            })
+        bus = events.ACTIVE
+        if bus is not None:
+            bus.emit("queue.declared", {
+                "vhost": vhost_name, "queue": name, "durable": durable,
+                "exclusive": exclusive_owner is not None,
+                "auto_delete": auto_delete,
             })
         return queue
 
@@ -1228,6 +1249,11 @@ class Broker:
             self.cluster.queue_metas.pop((vhost.name, queue.name), None)
             self.cluster.broadcast_bg("meta.apply", {
                 "kind": "queue.deleted", "vhost": vhost.name, "name": queue.name})
+        bus = events.ACTIVE
+        if bus is not None:
+            bus.emit("queue.deleted", {
+                "vhost": vhost.name, "queue": queue.name, "messages": count,
+            })
         return count
 
     def schedule_queue_delete(
@@ -1655,6 +1681,9 @@ class Broker:
             mark1 = self.store.mark()
             if mark1 > mark0:
                 marks.append((mark0, mark1))
+        fh = events.FIREHOSE
+        if fh is not None and fh.tap_bindings:
+            fh.tap_publish(exchange_name, routing_key, body, queues)
         return message
 
     async def _publish_clustered(

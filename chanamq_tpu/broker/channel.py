@@ -16,7 +16,7 @@ import struct
 import time
 from typing import TYPE_CHECKING, Any, Optional
 
-from .. import trace
+from .. import events, trace
 from ..amqp.command import AMQCommand
 from ..amqp.constants import FRAME_OVERHEAD
 from ..amqp.methods import Basic
@@ -238,6 +238,9 @@ class ServerChannel:
         if tr is not None:
             tr.span(trace.DELIVER, t_del, time.perf_counter_ns(),
                     self.connection.broker.trace_node)
+        fh = events.FIREHOSE
+        if fh is not None and fh.tap_bindings:
+            fh.tap_deliver(queue.name, msg.exchange, msg.routing_key, body)
         if consumer.no_ack:
             if tr is not None:
                 # no-ack settles at delivery (AMQP 0-9-1 semantics)

@@ -43,7 +43,7 @@ from ..amqp.constants import (
 from ..amqp.frame import Frame, FrameError, FrameParser, HEARTBEAT_BYTES
 from ..amqp import methods as am
 from ..amqp.properties import BasicProperties
-from .. import profile, trace
+from .. import events, profile, trace
 from .broker import Broker, BrokerError
 from .channel import ChannelMode, Consumer, ServerChannel
 from ..flow import STAGE_THROTTLE
@@ -321,6 +321,12 @@ class AMQPConnection:
         self.broker.connections.add(self)
         try:
             await self._handshake()
+            bus = events.ACTIVE
+            if bus is not None:
+                bus.emit("connection.created", {
+                    "connection": self.id, "vhost": self.vhost_name,
+                    "user": self.username,
+                })
             await self._main_loop()
         except ConnectionClosed:
             pass
@@ -1165,6 +1171,12 @@ class AMQPConnection:
         except Exception:
             pass
         self.broker.metrics.connections_closed += 1
+        bus = events.ACTIVE
+        if bus is not None and self._opened:
+            bus.emit("connection.closed", {
+                "connection": self.id, "vhost": self.vhost_name,
+                "user": self.username,
+            })
         if not self.closed.done():
             self.closed.set_result(None)
 

@@ -1164,6 +1164,11 @@ class VHost:
         ("amq.topic", "topic"),
         ("amq.headers", "headers"),
         ("amq.match", "headers"),
+        # system exchanges (chanamq_tpu/events/): internal events and the
+        # firehose tap publish here; clients may bind/consume but the
+        # amq.* name guard keeps them undeclarable and undeletable
+        ("amq.chanamq.event", "topic"),
+        ("amq.chanamq.trace", "topic"),
     )
 
     def __init__(self, name: str) -> None:

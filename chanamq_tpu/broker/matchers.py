@@ -44,7 +44,15 @@ class Matcher:
         raise NotImplementedError
 
     def is_empty(self) -> bool:
+        """Subclasses override with an O(1) probe."""
         return not self.bindings()
+
+    # Subclasses also expose ``binding_table``: an alias of the live
+    # binding collection, identity-stable for the matcher's lifetime and
+    # only ever mutated in place — truthy iff any binding exists. The
+    # firehose caches it so its per-message hot-path gate is a plain
+    # attribute load + bool test, no method call, no trie walk.
+    binding_table: "dict | set" = {}
 
 
 class DirectMatcher(Matcher):
@@ -52,6 +60,7 @@ class DirectMatcher(Matcher):
 
     def __init__(self) -> None:
         self._bindings: dict[str, set[str]] = {}
+        self.binding_table = self._bindings
 
     def bind(self, key: str, queue: str, arguments: Optional[dict] = None) -> bool:
         queues = self._bindings.setdefault(key, set())
@@ -82,6 +91,9 @@ class DirectMatcher(Matcher):
     def bindings(self) -> list[tuple[str, str, Optional[dict]]]:
         return [(k, q, None) for k, qs in self._bindings.items() for q in sorted(qs)]
 
+    def is_empty(self) -> bool:
+        return not self._bindings
+
 
 class FanoutMatcher(Matcher):
     """Routing key ignored; all bound queues match (reference: FanoutMatcher)."""
@@ -89,6 +101,7 @@ class FanoutMatcher(Matcher):
     def __init__(self) -> None:
         self._queues: dict[str, int] = {}  # queue -> bind count (distinct keys)
         self._keys: set[tuple[str, str]] = set()
+        self.binding_table = self._keys
 
     def bind(self, key: str, queue: str, arguments: Optional[dict] = None) -> bool:
         if (key, queue) in self._keys:
@@ -121,6 +134,9 @@ class FanoutMatcher(Matcher):
     def bindings(self) -> list[tuple[str, str, Optional[dict]]]:
         return [(k, q, None) for (k, q) in sorted(self._keys)]
 
+    def is_empty(self) -> bool:
+        return not self._keys
+
 
 class _TrieNode:
     __slots__ = ("children", "queues")
@@ -141,6 +157,7 @@ class TopicMatcher(Matcher):
     def __init__(self) -> None:
         self._root = _TrieNode()
         self._patterns: dict[tuple[str, str], int] = {}  # (key, queue) marker
+        self.binding_table = self._patterns
 
     def bind(self, key: str, queue: str, arguments: Optional[dict] = None) -> bool:
         if (key, queue) in self._patterns:
@@ -209,6 +226,9 @@ class TopicMatcher(Matcher):
     def bindings(self) -> list[tuple[str, str, Optional[dict]]]:
         return [(k, q, None) for (k, q) in sorted(self._patterns)]
 
+    def is_empty(self) -> bool:
+        return not self._patterns
+
 
 _EMPTY_SET: frozenset = frozenset()
 
@@ -233,6 +253,7 @@ class HeadersMatcher(Matcher):
     def __init__(self) -> None:
         # (queue, frozen-args-key) -> (x_match_all, {header: value})
         self._bindings: dict[tuple[str, str], tuple[bool, dict]] = {}
+        self.binding_table = self._bindings
         # inverted indexes: (header, value) -> binding keys
         self._any_index: dict[tuple, set] = {}
         self._all_index: dict[tuple, set] = {}
@@ -359,6 +380,9 @@ class HeadersMatcher(Matcher):
             full["x-match"] = "all" if x_match_all else "any"
             out.append(("", queue, full))
         return out
+
+    def is_empty(self) -> bool:
+        return not self._bindings
 
 
 def matcher_for(exchange_type: str) -> Matcher:

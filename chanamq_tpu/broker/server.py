@@ -443,6 +443,22 @@ async def run_node(config) -> None:
             from .. import profile as profile_mod
 
             profile_mod.enable_from_config(config, server.broker)
+        # event bus + firehose (fourth ACTIVE-gate subsystem): installed
+        # before the cluster so lifecycle transitions and chaos fires are
+        # observable from the first moment they can happen
+        if (config.bool("chana.mq.events.enabled")
+                or config.bool("chana.mq.firehose.enabled")):
+            from .. import events as events_mod
+
+            bus, _ = events_mod.enable_from_config(config, server.broker)
+            if bus is not None:
+                restarts = int(
+                    os.environ.get("CHANAMQ_SHARD_RESTARTS", "0") or 0)
+                if restarts > 0:
+                    # this worker is a supervisor respawn: the one boot
+                    # event a consumer can alert on
+                    bus.emit("shard.restarted", {
+                        "shard": shard_index, "restarts": restarts})
         if config.bool("chana.mq.cluster.enabled"):
             from ..cluster.node import ClusterNode
 
@@ -523,6 +539,14 @@ async def run_node(config) -> None:
                 store_error_window=config.int(
                     "chana.mq.telemetry.store-error-window"),
             )
+            if config.bool("chana.mq.slo.enabled"):
+                # burn-rate SLOs ride the telemetry tick (slo/): specs
+                # from chana.mq.slo.* or POST /admin/slo/configure
+                from ..slo import engine_from_config
+
+                telemetry.set_slo(engine_from_config(
+                    config,
+                    config.duration_s("chana.mq.telemetry.interval") or 1.0))
             server.broker.telemetry = telemetry
             await telemetry.start()
         if config.bool("chana.mq.forecast.enabled"):

@@ -21,7 +21,7 @@ import logging
 import random
 from typing import Callable, Optional
 
-from .. import trace
+from .. import events, trace
 from .plan import Fault, FaultPlan
 
 log = logging.getLogger("chanamq.chaos")
@@ -123,6 +123,11 @@ class ChaosRuntime:
             # and remember the fire so traces whose window covers it get
             # tagged at finish (chanamq_tpu/trace/)
             trace.ACTIVE.note_chaos_fire(fault.rule)
+        bus = events.ACTIVE
+        if bus is not None:
+            bus.emit(f"chaos.fired.{fault.rule}", {
+                "rule": fault.rule, "kind": fault.kind, "site": site,
+            })
         log.debug("chaos fire: rule=%s kind=%s site=%s",
                   fault.rule, fault.kind, site)
 

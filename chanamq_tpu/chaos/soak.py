@@ -563,6 +563,40 @@ async def run_overload_soak(
         runtime = install(plan, metrics=broker.metrics)
         fingerprint = plan.fingerprint()
 
+        # -- event bus + SLO engine (the observability demo): an AMQP
+        #    consumer on amq.chanamq.event watches the ladder escalate
+        #    (flow.stage.*), the memory-pressure alert fire, and the
+        #    readiness SLO burn/clear — all as ordinary messages. The SLO
+        #    spec's windows are tiny because the harness drives exactly 2
+        #    not-ready ticks at the refuse stage and 4 ready ticks after
+        #    recovery: both pairs must fire at the stage and clear by the
+        #    final tick, every run.
+        import json as json_mod
+
+        from .. import events as events_mod
+        from ..slo import SLOEngine, SLOSpec
+
+        svc.set_slo(SLOEngine([SLOSpec(
+            "readiness", "readiness", objective=0.999,
+            fast_windows=(2, 4), slow_windows=(4, 8),
+            fast_burn=10.0, slow_burn=10.0, budget_window=64)]))
+        ev_conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        conns.append(ev_conn)
+        ev_ch = await ev_conn.channel()
+        await ev_ch.queue_declare("ovl-events")
+        for pattern in ("flow.#", "alert.#", "slo.#"):
+            await ev_ch.queue_bind("ovl-events", "amq.chanamq.event",
+                                   pattern)
+        observed_events: list[str] = []
+
+        def on_bus(msg):
+            observed_events.append(json_mod.loads(bytes(msg.body))["event"])
+            ev_ch.basic_ack(msg.delivery_tag)
+
+        await ev_ch.basic_consume("ovl-events", on_bus,
+                                  consumer_tag="ovl-events")
+        events_mod.install(events_mod.EventBus(broker))
+
         deliveries: dict[bytes, int] = {}
 
         # -- well-behaved publisher P1: floods a backlog before the
@@ -735,6 +769,41 @@ async def run_overload_soak(
                 f"alerts still firing after recovery: "
                 f"{[i['rule'] for i in snapshot['firing']]}")
 
+        # -- the event-bus/SLO demo assertions: the consumer saw the
+        #    escalation, the alert and the burn; the budget drew down;
+        #    the burn cleared once the post-recovery ticks went ready
+        slo_snap = svc.slo.snapshot()
+        slo_budget = slo_snap["slos"][0]["budget_remaining"]
+        required_events = (
+            "flow.stage.4",                       # ladder hit refuse
+            "alert.fired.memory-pressure",
+            "slo.burn-rate.readiness",
+        )
+        deadline = asyncio.get_event_loop().time() + 10
+        while (not all(ev in observed_events for ev in required_events)
+               and asyncio.get_event_loop().time() < deadline):
+            await asyncio.sleep(0.05)
+        event_stream_ok = True
+        for ev in required_events:
+            if ev not in observed_events:
+                event_stream_ok = False
+                violations.append(
+                    f"event-bus consumer never saw {ev!r} "
+                    f"(got {observed_events})")
+        if slo_budget >= 1.0:
+            violations.append(
+                f"slo budget never drew down: {slo_budget}")
+        if slo_snap["firing"]:
+            violations.append(
+                f"slo pairs still burning after recovery: "
+                f"{[f['slo'] + ':' + f['pair'] for f in slo_snap['firing']]}")
+        if slo_snap["fired_total"] < 2 or slo_snap["cleared_total"] \
+                != slo_snap["fired_total"]:
+            violations.append(
+                f"slo burn/clear not exact: fired={slo_snap['fired_total']} "
+                f"cleared={slo_snap['cleared_total']} (want both pairs "
+                f"fired and cleared)")
+
         m = broker.metrics
         return {
             "seed": seed,
@@ -772,10 +841,20 @@ async def run_overload_soak(
             "alerts": {"fired_rules": list(fired),
                        "fired_total": snapshot["fired_total"],
                        "resolved_total": snapshot["resolved_total"]},
+            "events": {"observed": observed_events,
+                       "event_stream_ok": event_stream_ok,
+                       "published": m.events_published_total,
+                       "dropped": m.events_dropped_total},
+            "slo": {"budget_remaining": slo_budget,
+                    "fired_total": slo_snap["fired_total"],
+                    "cleared_total": slo_snap["cleared_total"],
+                    "slo_burned": slo_budget < 1.0},
             "chaos": runtime.status(),
             "violations": violations,
         }
     finally:
+        from .. import events as events_mod
+        events_mod.install(None)
         clear()
         for conn in conns:
             try:
@@ -1249,17 +1328,44 @@ async def _alert_phase(srv, cl, violations: list[str]) -> dict:
     stalled consumer (prefetch 1, never acks -> consumer-stall), ticking
     the sampler by hand. The engine's input is then a pure function of
     the workload, so the set of fired rules must match
-    EXPECTED_ALERT_RULES exactly — no more, no fewer."""
+    EXPECTED_ALERT_RULES exactly — no more, no fewer.
+
+    Invariant 6c (event bus): a plain AMQP consumer bound ``alert.#`` +
+    ``lifecycle.#`` on ``amq.chanamq.event`` must receive exactly the
+    engine's fire/resolve transitions as messages — same rules, same
+    order — and zero lifecycle events (nothing drains in this soak).
+    Deterministic mod the wall-clock ``ts`` stamp in each body."""
+    import json as json_mod
+
+    from .. import events as events_mod
     from ..client.client import AMQPClient
 
     svc = srv.broker.telemetry
     aq = next(f"ca{i}" for i in range(200)
               if cl.queue_owner("/", f"ca{i}") == cl.name)
+    eq = next(f"ce{i}" for i in range(200)
+              if cl.queue_owner("/", f"ce{i}") == cl.name)
     conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+    bus_events: list[dict] = []
     try:
         ch = await conn.channel()
         await ch.confirm_select()
         await ch.queue_declare(aq)
+
+        # event consumer FIRST, bus installed after its own connection
+        # setup so the collected stream starts exactly at the phase start
+        e_ch = await conn.channel()
+        await e_ch.queue_declare(eq)
+        await e_ch.queue_bind(eq, "amq.chanamq.event", "alert.#")
+        await e_ch.queue_bind(eq, "amq.chanamq.event", "lifecycle.#")
+
+        def on_event(msg):
+            bus_events.append(json_mod.loads(bytes(msg.body)))
+            e_ch.basic_ack(msg.delivery_tag)
+
+        await e_ch.basic_consume(eq, on_event, consumer_tag="soak-events")
+        events_mod.install(events_mod.EventBus(srv.broker))
+
         # baseline tick: the queue's ring slot needs one pre-backlog
         # sample for the growth window to measure against
         svc.sample_tick(1.0)
@@ -1289,6 +1395,31 @@ async def _alert_phase(srv, cl, violations: list[str]) -> dict:
             violations.append(
                 f"alert firings not exact: expected {EXPECTED_ALERT_RULES}, "
                 f"got {fired}")
+
+        # invariant 6c: the consumed event stream mirrors the engine's own
+        # transition history exactly (order and rules), with no lifecycle
+        # noise. Emits are synchronous at the tick; only the AMQP delivery
+        # to our consumer is async, so give it a bounded settle window.
+        expected_stream = [
+            ("fired" if ev["event"] == "fired" else "cleared", ev["rule"])
+            for ev in svc.engine.history]
+        deadline = asyncio.get_event_loop().time() + 10
+        while (len(bus_events) < len(expected_stream)
+               and asyncio.get_event_loop().time() < deadline):
+            await asyncio.sleep(0.05)
+        got_stream = [tuple(ev["event"].split(".", 1)[-1].split(".", 1))
+                      if ev["event"].startswith("alert.")
+                      else ("lifecycle", ev["event"])
+                      for ev in bus_events]
+        lifecycle_seen = [ev["event"] for ev in bus_events
+                          if ev["event"].startswith("lifecycle.")]
+        if lifecycle_seen:
+            violations.append(
+                f"unexpected lifecycle events on the bus: {lifecycle_seen}")
+        if got_stream != expected_stream:
+            violations.append(
+                f"event-bus alert stream mismatch: expected "
+                f"{expected_stream}, got {got_stream}")
         return {
             "queue": aq,
             "fired_rules": list(fired),
@@ -1296,8 +1427,11 @@ async def _alert_phase(srv, cl, violations: list[str]) -> dict:
             "resolved_total": snapshot["resolved_total"],
             "firing_now": [
                 f"{i['rule']}:{i['entity']}" for i in snapshot["firing"]],
+            "bus_events": [ev["event"] for ev in bus_events],
+            "bus_stream_exact": got_stream == expected_stream,
         }
     finally:
+        events_mod.install(None)
         try:
             await conn.close()
         except Exception:

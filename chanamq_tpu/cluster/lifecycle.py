@@ -41,7 +41,7 @@ import logging
 import time
 from typing import TYPE_CHECKING, Optional
 
-from .. import chaos
+from .. import chaos, events
 from .membership import DRAINING, LEFT
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -82,6 +82,19 @@ class LifecycleCoordinator:
         self._task: Optional[asyncio.Task] = None
         self._done = asyncio.Event()
 
+    def _set_state(self, state: str) -> None:
+        """Transition the drain state machine, announcing the move on the
+        event bus (``lifecycle.<state>``) when one is installed."""
+        self.state = state
+        bus = events.ACTIVE
+        if bus is not None:
+            bus.emit(f"lifecycle.{state}", {
+                "node": self.node.name, "state": state,
+                "queues_total": self.queues_total,
+                "queues_moved": self.queues_moved,
+                "retries": self.retries,
+            })
+
     # ------------------------------------------------------------------
     # public surface (admin + soak)
     # ------------------------------------------------------------------
@@ -91,7 +104,7 @@ class LifecycleCoordinator:
         the evacuation itself runs as a background task."""
         if self._task is None:
             self.node.broker.metrics.lifecycle_drains_started += 1
-            self.state = "draining"
+            self._set_state("draining")
             self._started_mono = time.monotonic()
             self._done.clear()
             self._task = asyncio.get_event_loop().create_task(self._run())
@@ -289,19 +302,19 @@ class LifecycleCoordinator:
             if not self.failed and not self.pinned:
                 if node.membership is not None:
                     node.membership.set_lifecycle(LEFT)
-                self.state = "drained"
+                self._set_state("drained")
                 log.info("%s: drain complete (%d queues evacuated)",
                          node.name, self.queues_moved)
             else:
-                self.state = "stuck"
+                self._set_state("stuck")
                 log.warning(
                     "%s: drain stuck (%d moved, failed=%s, pinned=%s)",
                     node.name, self.queues_moved, self.failed, self.pinned)
         except asyncio.CancelledError:
-            self.state = "stuck"
+            self._set_state("stuck")
             raise
         except Exception:
-            self.state = "stuck"
+            self._set_state("stuck")
             log.exception("%s: drain loop crashed", node.name)
         finally:
             self._done.set()

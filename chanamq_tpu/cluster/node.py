@@ -763,6 +763,7 @@ class ClusterNode:
         rpc.register("consumer.credit", self._h_consumer_credit)
         rpc.register("consumer.cancelled", self._h_consumer_cancelled)
         rpc.register("telemetry.pull", self._h_telemetry_pull)
+        rpc.register("slo.pull", self._h_slo_pull)
         rpc.register("control.load", self._h_control_load)
         # data plane: binary zero-copy bodies, no field-table codec
         rpc.register_binary(dp.METHOD_PUSH_MANY, self._hb_push_many)
@@ -1700,6 +1701,14 @@ class ClusterNode:
         window = max(1, min(int(payload.get("window", 60)), 4096))
         top = max(0, int(payload.get("top", 0)))
         return svc.local_payload(window, top)
+
+    async def _h_slo_pull(self, payload: dict) -> dict:
+        """Serve this node's SLO snapshot to a peer aggregating the
+        cluster view (any node's GET /admin/slo?scope=cluster)."""
+        svc = self.broker.telemetry
+        if svc is None or svc.slo is None:
+            return {"node": self.name, "error": "slo disabled"}
+        return {"node": self.name, **svc.slo.snapshot()}
 
     async def _h_control_load(self, payload: dict) -> dict:
         """Serve this node's inflow-load figure (bytes/s EWMA) to a peer's

@@ -351,6 +351,34 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.profile.ring-size": 64,
     # attribute collector pauses via gc.callbacks (the "gc" ledger stage)
     "chana.mq.profile.gc": True,
+    # broker-native event bus (chanamq_tpu/events/): internal transitions
+    # (alert.fired.<rule>, control.decision.<kind>, lifecycle.<state>,
+    # flow.stage.<n>, chaos.fired.<rule>, profile.slow-callback,
+    # connection.*, queue.*, shard.restarted, slo.burn-rate.<name>)
+    # published as AMQP messages on the amq.chanamq.event topic exchange
+    # of this vhost. Off = every emit seam is one `ACTIVE is None` check;
+    # on with nothing bound = O(1) counted drop per event.
+    "chana.mq.events.enabled": False,
+    "chana.mq.events.vhost": "/",
+    # firehose tracer: republish every publish/deliver into
+    # amq.chanamq.trace (keys publish.<exchange> / deliver.<queue>),
+    # shedding taps whenever the flow accountant leaves stage 0 so a slow
+    # firehose consumer can never build unbounded memory. queue-filter
+    # narrows the tap to queues whose name starts with the prefix.
+    "chana.mq.firehose.enabled": False,
+    "chana.mq.firehose.vhost": "/",
+    "chana.mq.firehose.queue-filter": "",
+    # SLO engine (chanamq_tpu/slo/): burn-rate error budgets over the
+    # telemetry tick (requires chana.mq.telemetry.enabled). Default specs
+    # cover publish availability, delivery success, readiness, and
+    # delivery p99 latency; replace them with chana.mq.slo.specs (a JSON
+    # list, see slo.specs_from_json) or POST /admin/slo/configure.
+    "chana.mq.slo.enabled": False,
+    "chana.mq.slo.objective": 0.999,        # default success-ratio target
+    "chana.mq.slo.latency-ms": 250,         # p99 bound for the latency SLO
+    "chana.mq.slo.fast-burn": 14.4,         # 5m/1h pair burn threshold
+    "chana.mq.slo.slow-burn": 6.0,          # 6h/3d pair burn threshold
+    "chana.mq.slo.specs": None,
 }
 
 _DURATION_RE = re.compile(r"^\s*([0-9.]+)\s*(ms|s|m|h|d)?\s*$")

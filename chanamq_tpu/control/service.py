@@ -30,7 +30,7 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
-from .. import trace
+from .. import events, trace
 from ..flow import STAGE_THROTTLE
 from .engine import ControlConfig, ControlEngine, ControlInputs, QueueInput
 
@@ -202,6 +202,9 @@ class ControlService:
             entry["applied"] = applied
             entry["dry_run"] = self.dry_run
             self.log.append(entry)
+            bus = events.ACTIVE
+            if bus is not None:
+                bus.emit(f"control.decision.{decision['kind']}", entry)
             if trace.ACTIVE is not None:
                 trace.ACTIVE.note_chaos_fire(
                     f"control:{decision['kind']}:{decision['id']}")

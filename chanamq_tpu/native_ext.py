@@ -223,6 +223,7 @@ class NativeTopicMatcher(Matcher):
         self._queue_names: dict[int, str] = {}
         self._next_id = 1
         self._patterns: dict[tuple[str, str], int] = {}
+        self.binding_table = self._patterns
         self._out = (ctypes.c_int32 * 4096)()
 
     def __del__(self) -> None:  # pragma: no cover
@@ -275,3 +276,6 @@ class NativeTopicMatcher(Matcher):
 
     def bindings(self) -> list[tuple[str, str, Optional[dict]]]:
         return [(k, q, None) for (k, q) in sorted(self._patterns)]
+
+    def is_empty(self) -> bool:
+        return not self._patterns

@@ -26,6 +26,8 @@ import threading
 import time
 from collections import deque
 
+from .. import events
+
 log = logging.getLogger("chanamq.profile")
 
 # folded-stack table cap: beyond this, new unique stacks fold into the
@@ -132,6 +134,13 @@ class Sampler(threading.Thread):
             "slow event-loop callback: %.1f ms", duration_ms,
             extra={"data": {"node": node, "duration_ms": duration_ms,
                             "stack": self._stall_stack}})
+        bus = events.ACTIVE
+        if bus is not None:
+            # sampler thread -> loop thread: the bus publishes AMQP
+            # messages, which only the owning loop may do
+            bus.emit_threadsafe("profile.slow-callback", {
+                "duration_ms": duration_ms, "stack": entry["stack"],
+            })
 
     def collapsed(self) -> str:
         rows = sorted(self.stacks.items(), key=lambda kv: (-kv[1], kv[0]))

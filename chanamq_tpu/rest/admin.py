@@ -236,6 +236,12 @@ class AdminServer:
             return ("GET", lambda: {"live": True})
         if rest == ["alerts"]:
             return ("GET", lambda: self._alerts(query))
+        if rest == ["slo"]:
+            return ("GET", lambda: self._slo(query))
+        if rest == ["slo", "configure"]:
+            return ("POST", lambda: self._slo_configure(body))
+        if rest == ["events"]:
+            return ("GET", self._events_status)
         return None
 
     @staticmethod
@@ -396,6 +402,113 @@ class AdminServer:
                         "resolved_total": alerts["resolved_total"],
                         "fired_rules": alerts["fired_rules"],
                     }
+        return out
+
+    # -- SLOs and the event bus (chanamq_tpu/slo/, chanamq_tpu/events/) ----
+
+    def _slo_engine(self):
+        svc = self._svc()
+        if svc.slo is None:
+            raise AdminError(
+                "409 Conflict",
+                "slo disabled: boot with chana.mq.slo.enabled or POST "
+                "/admin/slo/configure")
+        return svc, svc.slo
+
+    async def _slo(self, query: dict) -> dict:
+        """SLO specs, burn rates, error budgets and firing pairs —
+        cluster-aggregated by default (each node evaluates its own SLIs;
+        the pager view wants every node's budget plus the cluster's
+        worst case). ?scope=local skips the peer pull."""
+        _, engine = self._slo_engine()
+        out = {"node": self.broker.trace_node, **engine.snapshot()}
+        if query.get("scope") == "local":
+            return out
+        me = self.broker.trace_node
+
+        def _summary(snap: dict) -> dict:
+            return {
+                "firing": snap.get("firing", []),
+                "fired_total": snap.get("fired_total", 0),
+                "budget": {s["name"]: s["budget_remaining"]
+                           for s in snap.get("slos", [])},
+            }
+
+        out["cluster"] = {me: _summary(out)}
+        cluster = self.broker.cluster
+        if cluster is not None and cluster.membership is not None:
+            for peer in cluster.membership.alive_members():
+                if peer == cluster.name:
+                    continue
+                try:
+                    snap = await cluster._call(
+                        peer, "slo.pull", {}, timeout_s=2.0)
+                except Exception as exc:
+                    out["cluster"][peer] = {
+                        "error": f"pull failed: {type(exc).__name__}"}
+                    continue
+                if "error" in snap:
+                    out["cluster"][peer] = {"error": snap["error"]}
+                else:
+                    out["cluster"][peer] = _summary(snap)
+        # the cluster-level answer: per SLO, the worst remaining budget
+        # across nodes (one node burning is the on-call's problem)
+        worst: dict = {}
+        for entry in out["cluster"].values():
+            for name, remaining in (entry.get("budget") or {}).items():
+                worst[name] = min(worst.get(name, 1.0), remaining)
+        out["budget_worst_case"] = worst
+        return out
+
+    def _slo_configure(self, body: bytes) -> dict:
+        """Replace the SLO spec set at runtime. Budgets and burn windows
+        reset with the specs (they are properties of the objective, not
+        of the process). Installs onto a telemetry service booted without
+        SLOs too — the next tick starts evaluating."""
+        from ..slo import SLOEngine, default_slos, specs_from_json
+
+        svc = self._svc()
+        try:
+            req = json.loads(body or b"{}")
+        except ValueError as exc:
+            raise AdminError("400 Bad Request", f"bad json: {exc}")
+        raw = req.get("specs") if isinstance(req, dict) else req
+        try:
+            if raw:
+                engine = SLOEngine(specs_from_json(raw, svc.interval_s))
+            else:
+                engine = SLOEngine(default_slos(svc.interval_s))
+        except ValueError as exc:
+            raise AdminError("400 Bad Request", str(exc))
+        svc.set_slo(engine)
+        return {"ok": True,
+                "slos": [spec.name for spec in engine.specs]}
+
+    def _events_status(self) -> dict:
+        """Event-bus + firehose status: installed?, exchanges, publish /
+        drop counters (the operator's 'is anything listening?' check)."""
+        from .. import events as events_mod
+
+        bus = events_mod.ACTIVE
+        fh = events_mod.FIREHOSE
+        m = self.broker.metrics
+        out: dict = {
+            "enabled": bus is not None,
+            "firehose_enabled": fh is not None,
+            "events": {
+                "published": m.events_published_total,
+                "dropped": m.events_dropped_total,
+            },
+            "firehose": {
+                "published": m.firehose_published_total,
+                "dropped": m.firehose_dropped_total,
+            },
+        }
+        if bus is not None:
+            out["bus"] = bus.snapshot()
+        if fh is not None:
+            out["firehose"].update({
+                "vhost": fh.vhost, "queue_filter": fh.queue_filter})
         return out
 
     # -- message tracing (chanamq_tpu/trace/) ------------------------------
@@ -592,6 +705,9 @@ class AdminServer:
         "router_fallback_msgs", "router_parity_mismatches",
         "profile_samples_total", "profile_slow_callbacks_total",
         "profile_gc_pauses_total", "profile_gc_pause_ns_total",
+        "events_published_total", "events_dropped_total",
+        "firehose_published_total", "firehose_dropped_total",
+        "slo_violations_total",
     })
 
     @staticmethod
@@ -704,6 +820,26 @@ class AdminServer:
                     f'entity="{self._prom_label(info["entity"])}",'
                     f'severity="{self._prom_label(info["severity"])}"}}')
                 out.append(f"chanamq_alert_firing{labels} 1")
+        if telemetry is not None and telemetry.slo is not None:
+            # one budget/burn-rate pair of series per SLO spec: the
+            # dashboards the burn-rate alerts point the operator at
+            engine = telemetry.slo
+            out.append("# TYPE chanamq_slo_budget_remaining gauge")
+            out.append("# TYPE chanamq_slo_burn_rate gauge")
+            for spec in engine.specs:
+                status = engine.slo_status(spec)
+                slabels = (f'{{slo="{self._prom_label(spec.name)}",'
+                           f'sli="{self._prom_label(spec.sli)}"}}')
+                out.append(
+                    f"chanamq_slo_budget_remaining{slabels} "
+                    f"{status['budget_remaining']}")
+                for pair in ("fast", "slow"):
+                    blabels = (f'{{slo="{self._prom_label(spec.name)}",'
+                               f'sli="{self._prom_label(spec.sli)}",'
+                               f'window="{pair}"}}')
+                    out.append(
+                        f"chanamq_slo_burn_rate{blabels} "
+                        f"{status['burn'][f'{pair}_short']['burn_rate']}")
         forecaster = getattr(self.broker, "forecaster", None)
         if forecaster is not None and forecaster.forecast is not None:
             # next-tick telemetry forecast (models/service.py): one gauge

@@ -123,10 +123,17 @@ def evaluate_health(broker: "Broker", svc: "TelemetryService") -> dict:
         checks["shards"], shard_reasons = shards
         reasons.extend(shard_reasons)
 
-    return {
+    payload = {
         "node": broker.trace_node,
         "live": True,
         "ready": not reasons,
         "reasons": reasons,
         "checks": checks,
     }
+    # SLO stamp (informational — burning budgets mean the objective is at
+    # risk, not that the node should stop taking traffic, so no reason is
+    # added): which SLOs are burning and how much budget remains
+    slo = getattr(svc, "slo", None)
+    if slo is not None:
+        payload["slo"] = slo.readiness_stamp()
+    return payload

@@ -35,7 +35,9 @@ from typing import TYPE_CHECKING, Hashable, Optional
 
 import numpy as np
 
+from .. import events as event_bus
 from .. import trace
+from ..slo import SLISampler, SLOEngine
 from .alerts import AlertEngine, AlertRule, default_rules
 from .health import evaluate_health
 from .store import CONN_FIELDS, QUEUE_FIELDS, EntityRings
@@ -63,6 +65,7 @@ class TelemetryService:
         loop_lag_ready_ms: float = 1000.0,
         repl_lag_ready: int = 10000,
         store_error_window: int = 30,
+        slo: Optional[SLOEngine] = None,
     ) -> None:
         self.broker = broker
         self.interval_s = interval_s
@@ -72,6 +75,12 @@ class TelemetryService:
         self.engine = AlertEngine(
             rules if rules is not None else default_rules())
         self.alerts_enabled = alerts_enabled
+        # SLO engine rides the same tick (None: feature off); the sampler
+        # turns broker counters into per-tick (good, bad) SLI deltas
+        self.slo: Optional[SLOEngine] = None
+        self.slo_sampler: Optional[SLISampler] = None
+        if slo is not None:
+            self.set_slo(slo)
 
         # readiness thresholds (health.py reads these off the service)
         self.loop_lag_ready_ms = loop_lag_ready_ms
@@ -96,6 +105,18 @@ class TelemetryService:
         self._store_err_totals: list[int] = []
         self._task: Optional[asyncio.Task] = None
         self._last = 0.0
+
+    def set_slo(self, engine: SLOEngine) -> None:
+        """Install (or replace: POST /admin/slo/configure) the SLO engine.
+        A replacement starts with fresh rings — budgets are a property of
+        the spec set, so they reset with it."""
+        self.slo = engine
+        threshold = 250.0
+        for spec in engine.specs:
+            if spec.sli == "delivery-latency":
+                threshold = spec.threshold_ms
+                break
+        self.slo_sampler = SLISampler(self.broker, threshold)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -167,6 +188,9 @@ class TelemetryService:
 
         health = evaluate_health(broker, self)
         self.health_state = "ready" if health["ready"] else "not-ready"
+
+        if self.slo is not None and self.slo_sampler is not None:
+            self._evaluate_slo(bool(health["ready"]))
 
         self.tick_us = (time.perf_counter() - t0) * 1e6
         metrics.telemetry_ticks += 1
@@ -292,6 +316,36 @@ class TelemetryService:
                 metrics.alerts_resolved += 1
                 log.info("alert resolved: %s on %s after %d ticks",
                          ev["rule"], ev["entity"], ev["ticks"])
+        bus = event_bus.ACTIVE
+        if bus is not None:
+            for ev in events:
+                verb = "fired" if ev["event"] == "fired" else "cleared"
+                bus.emit(f"alert.{verb}.{ev['rule']}", dict(ev))
+
+    def _evaluate_slo(self, ready: bool) -> None:
+        """One SLO tick: sample SLIs, evaluate burn rates, surface burn /
+        clear transitions (metrics counter, structured log, event bus)."""
+        samples = self.slo_sampler.sample(ready)
+        slo_events = self.slo.evaluate(self.tick, samples)
+        if not slo_events:
+            return
+        metrics = self.broker.metrics
+        bus = event_bus.ACTIVE
+        for ev in slo_events:
+            if ev["event"] == "burn":
+                metrics.slo_violations_total += 1
+                log.warning(
+                    "slo burn-rate: %s/%s burning (short=%.3g long=%.3g "
+                    "threshold=%.3g budget_remaining=%.4f)",
+                    ev["slo"], ev["pair"], ev["burn_short"], ev["burn_long"],
+                    ev["threshold"], ev["budget_remaining"])
+                if bus is not None:
+                    bus.emit(f"slo.burn-rate.{ev['slo']}", dict(ev))
+            else:
+                log.info("slo cleared: %s/%s after %d ticks",
+                         ev["slo"], ev["pair"], ev["ticks"])
+                if bus is not None:
+                    bus.emit(f"slo.cleared.{ev['slo']}", dict(ev))
 
     # -- reads: metrics / admin / forecaster -------------------------------
 
@@ -345,6 +399,7 @@ class TelemetryService:
             "connections": connections,
             "probes": self.node_probes(),
             "alerts": self.engine.snapshot(),
+            "slo": self.slo.snapshot() if self.slo is not None else None,
             "health": self.health(),
             "stats": {"queues": self.queues.stats(),
                       "connections": self.conns.stats(),

@@ -226,6 +226,19 @@ class Metrics:
         self.profile_slow_callbacks_total = 0
         self.profile_gc_pauses_total = 0
         self.profile_gc_pause_ns_total = 0
+        # event bus + firehose (chanamq_tpu/events/): events that reached
+        # at least one bound queue vs O(1) drops (nothing bound, or the
+        # bus swallowed an emit error), and firehose taps published vs
+        # shed (flow stage > 0 or no trace binding). All zero unless
+        # chana.mq.events.enabled / chana.mq.firehose.enabled.
+        self.events_published_total = 0
+        self.events_dropped_total = 0
+        self.firehose_published_total = 0
+        self.firehose_dropped_total = 0
+        # SLO engine (chanamq_tpu/slo/): burn-rate alert firings across
+        # all specs and window pairs (per-spec counts live in the engine
+        # snapshot and the chanamq_slo_violations_total labeled series)
+        self.slo_violations_total = 0
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -382,6 +395,11 @@ class Metrics:
             "profile_slow_callbacks_total": self.profile_slow_callbacks_total,
             "profile_gc_pauses_total": self.profile_gc_pauses_total,
             "profile_gc_pause_ns_total": self.profile_gc_pause_ns_total,
+            "events_published_total": self.events_published_total,
+            "events_dropped_total": self.events_dropped_total,
+            "firehose_published_total": self.firehose_published_total,
+            "firehose_dropped_total": self.firehose_dropped_total,
+            "slo_violations_total": self.slo_violations_total,
         }
         for key, hist in self.trace_stage_us.items():
             base = key[:-3] if key.endswith("_us") else key

@@ -13,6 +13,13 @@ if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
 
+echo "tier1: metrics-registry lint (every exported chanamq_* series documented)"
+python scripts/metrics_lint.py || {
+    rc=$?
+    echo "tier1: metrics lint FAILED (rc=$rc) — undocumented Prometheus series" >&2
+    exit "$rc"
+}
+
 echo "tier1: 2-node cluster bench smoke (5 s)"
 BENCH_SECONDS=5 timeout -k 10 120 python bench.py --cluster || {
     rc=$?
@@ -42,6 +49,10 @@ grep -q '"fired_rules": \["backlog-growth", "consumer-stall"\]' /tmp/_t1_chaos.j
     echo "tier1: chaos soak report missing the exact alert firings" >&2
     exit 1
 }
+grep -q '"bus_stream_exact": true' /tmp/_t1_chaos.json || {
+    echo "tier1: chaos soak event-bus stream did not match the engine history" >&2
+    exit 1
+}
 
 echo "tier1: overload soak smoke (~7 s: memory-pressure chaos, refuse + recover)"
 # the soak itself fails (violation -> exit 1) on confirmed loss, missing
@@ -55,6 +66,17 @@ timeout -k 10 180 python bench.py --overload --seed 7 \
 }
 grep -q '"under_hard_limit": true' /tmp/_t1_overload.json || {
     echo "tier1: overload soak exceeded the accounted-byte hard limit" >&2
+    exit 1
+}
+# the ISSUE-15 live-demo path: a consumer on amq.chanamq.event must see
+# the stage escalation, the memory-pressure alert and an slo.burn-rate
+# event, and the SLO budget must actually draw down
+grep -q '"event_stream_ok": true' /tmp/_t1_overload.json || {
+    echo "tier1: overload soak event-bus consumer missed a required event" >&2
+    exit 1
+}
+grep -q '"slo_burned": true' /tmp/_t1_overload.json || {
+    echo "tier1: overload soak SLO budget never drew down" >&2
     exit 1
 }
 
@@ -163,6 +185,36 @@ for attempt in 1 2 3; do
 done
 [ -n "$ok" ] || {
     echo "tier1: profile overhead smoke FAILED (3 attempts) — ledger cost over budget" >&2
+    exit 1
+}
+
+echo "tier1: event-bus overhead smoke (5 s x2: bus + firehose, nothing bound, <= 2%)"
+# same retry rationale as the other overhead gates
+ok=""
+for attempt in 1 2 3; do
+    if BENCH_SECONDS=5 timeout -k 10 120 python bench.py --event-overhead; then
+        ok=1
+        break
+    fi
+    echo "tier1: event overhead attempt $attempt over budget, retrying" >&2
+done
+[ -n "$ok" ] || {
+    echo "tier1: event overhead smoke FAILED (3 attempts) — bus/firehose cost over budget" >&2
+    exit 1
+}
+
+echo "tier1: SLO overhead smoke (5 s x2: SLI sampler + burn-rate eval <= 2%)"
+# same retry rationale as the other overhead gates
+ok=""
+for attempt in 1 2 3; do
+    if BENCH_SECONDS=5 timeout -k 10 120 python bench.py --slo-overhead; then
+        ok=1
+        break
+    fi
+    echo "tier1: SLO overhead attempt $attempt over budget, retrying" >&2
+done
+[ -n "$ok" ] || {
+    echo "tier1: SLO overhead smoke FAILED (3 attempts) — SLO engine cost over budget" >&2
     exit 1
 }
 
