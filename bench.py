@@ -2162,6 +2162,89 @@ def main() -> None:
         ], budget_pct=-2.0)
         return
 
+    if "--tenant" in sys.argv:
+        # noisy-neighbor tenancy soak: three tenants on one node
+        # (chanamq_tpu/chaos/soak.py run_tenant_soak) — an aggressor
+        # floods past its publish-rate token bucket and a memory-share
+        # floor pins a backlog tenant, while the victim tenant's paced
+        # p99 and tenant-scoped SLO budgets must stay intact and the
+        # tenant-filtered event/firehose streams must carry exactly the
+        # expected traffic. The episode runs TWICE with the same seed
+        # and the tenancy decision logs must be byte-identical; any
+        # violation exits non-zero.
+        seed = 5
+        if "--seed" in sys.argv:
+            seed = int(sys.argv[sys.argv.index("--seed") + 1])
+        from chanamq_tpu.chaos.soak import run_tenant_soak
+
+        try:
+            result = asyncio.run(asyncio.wait_for(
+                run_tenant_soak(seed), timeout=240))
+        except Exception as exc:
+            result = {"seed": seed,
+                      "violations": [f"{type(exc).__name__}: {exc}"]}
+        print(f"# tenant_soak: violations={result.get('violations')} "
+              f"log_sha256={result.get('log_sha256')}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "tenant_soak_violations",
+            "value": len(result.get("violations", [])),
+            "unit": "violations",
+            "vs_baseline": None,
+            "seed": seed,
+            "log_sha256": result.get("log_sha256"),
+            "runs": result.get("runs", []),
+            "violations": result.get("violations", []),
+        }))
+        if result.get("violations"):
+            sys.exit(1)  # the tier-1 smoke must fail loudly
+        return
+
+    if "--tenant-churn" in sys.argv:
+        # tenant-churn leak check: N define/remove rounds against a live
+        # registry, every 100th with a full authenticated AMQP sub-cycle
+        # (vhost create / connect / declare / publish-confirmed / delete)
+        # — at the end every registry slot, auth view, accounted byte and
+        # vhost must be exactly at baseline (chanamq_tpu/chaos/soak.py
+        # run_tenant_churn). Any leaked slot or byte exits 1.
+        cycles = int(os.environ.get("TENANT_CHURN_CYCLES", "10000"))
+        from chanamq_tpu.chaos.soak import run_tenant_churn
+
+        try:
+            result = asyncio.run(asyncio.wait_for(
+                run_tenant_churn(cycles), timeout=240))
+        except Exception as exc:
+            result = {"cycles": cycles,
+                      "violations": [f"{type(exc).__name__}: {exc}"]}
+        print(f"# tenant_churn: {result}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "tenant_churn_leaked_bytes",
+            "value": result.get("leaked_bytes"),
+            "unit": "bytes",
+            "vs_baseline": None,
+            "cycles": result.get("cycles"),
+            "amqp_cycles": result.get("amqp_cycles"),
+            "registry_slots": result.get("registry_slots"),
+            "tenant_churn": result,
+        }))
+        if result.get("violations"):
+            sys.exit(1)  # the tier-1 smoke must fail loudly
+        return
+
+    if "--tenant-overhead" in sys.argv:
+        # tenancy cost with one quota-less tenant owning "/" — the
+        # connection resolves its tenant once at Connection.Open; the
+        # publish hot path then pays one attribute load + None check
+        # (no rate quota -> no bucket spend) and the delivery path one
+        # histogram-presence check. Held to the same <= 2% budget as
+        # every other subsystem.
+        run_overhead("tenant_overhead_pct", [
+            ("off", None),
+            ("on", {"CHANAMQ_TENANT_ENABLED": "true",
+                    "CHANAMQ_TENANT_TENANTS":
+                        '{"t0": {"vhosts": ["/"]}}'}),
+        ], budget_pct=-2.0)
+        return
+
     if "--profile" in sys.argv:
         # attribution smoke: ledger + sampler on, /admin/profile scraped
         # around the load window — gates on >=5 stages with traffic,

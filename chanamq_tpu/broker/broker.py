@@ -198,6 +198,10 @@ class Broker:
                 refuse_watermark=flow_refuse_watermark,
             )
             self.flow.listeners.append(self._on_flow_stage)
+        # multi-tenancy registry (chanamq_tpu/tenancy/): None unless
+        # chana.mq.tenant.enabled — every enforcement seam is one
+        # attribute load + identity check when off
+        self.tenancy: Optional[Any] = None
         self.blocked = False
         self.blocked_reason = ""  # wire-visible cause (Connection.Blocked)
         self._mem_over = False    # resident_bytes above the RAM watermark
@@ -887,6 +891,12 @@ class Broker:
             self._check_exclusive(existing, connection_id)
             existing.touch()
             return existing
+        if self.tenancy is not None:
+            # tenant queue quota, checked only for NEW queues (re-declares
+            # and passive declares of existing queues stay free)
+            refusal = self.tenancy.queue_refusal(vhost_name)
+            if refusal is not None:
+                raise BrokerError(ErrorCode.PRECONDITION_FAILED, refusal)
         arguments = arguments or {}
         self._validate_queue_args(arguments)
         ttl_ms = arguments.get("x-message-ttl")
@@ -1092,6 +1102,12 @@ class Broker:
         if exchange_name == "":
             raise BrokerError(
                 ErrorCode.ACCESS_REFUSED, "cannot bind to the default exchange")
+        if self.tenancy is not None:
+            # tenant binding quota, counted live off the matchers
+            # (conservative: at the cap even an idempotent re-bind refuses)
+            refusal = self.tenancy.binding_refusal(vhost_name)
+            if refusal is not None:
+                raise BrokerError(ErrorCode.PRECONDITION_FAILED, refusal)
         added = exchange.matcher.bind(routing_key, queue_name, arguments)
         if added:
             self.invalidate_routes(vhost_name, exchange_name)
@@ -1908,6 +1924,10 @@ class Broker:
                             expired_queues.append(queue)
                 if self.flow is not None:
                     self._flow_tick(stream_cache_bytes)
+                if self.tenancy is not None:
+                    # refill tenant token buckets and move memory-share
+                    # floors (one pass over the registry per sweep)
+                    self.tenancy.tick(self.message_sweep_interval_s or 1.0)
                 if timeout:
                     # ack timeout: walk every live connection's channels —
                     # the one registry where every outstanding delivery

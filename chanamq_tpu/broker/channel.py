@@ -233,14 +233,20 @@ class ServerChannel:
             consumer.buffered_bytes += len(body)
         metrics = self.connection.broker.metrics
         metrics.delivered(len(body))
-        metrics.publish_to_deliver_us.observe_us(
-            (time.perf_counter_ns() - msg.published_ns) / 1000.0)
+        us = (time.perf_counter_ns() - msg.published_ns) / 1000.0
+        metrics.publish_to_deliver_us.observe_us(us)
+        tenant = self.connection.tenant
+        if tenant is not None and tenant.latency_hist is not None:
+            # per-tenant publish->deliver histogram: allocated only when a
+            # delivery-latency SLO targets the tenant (tenancy/registry.py)
+            tenant.latency_hist.observe_us(us)
         if tr is not None:
             tr.span(trace.DELIVER, t_del, time.perf_counter_ns(),
                     self.connection.broker.trace_node)
         fh = events.FIREHOSE
         if fh is not None and fh.tap_bindings:
-            fh.tap_deliver(queue.name, msg.exchange, msg.routing_key, body)
+            fh.tap_deliver(queue.name, msg.exchange, msg.routing_key, body,
+                           queue.vhost)
         if consumer.no_ack:
             if tr is not None:
                 # no-ack settles at delivery (AMQP 0-9-1 semantics)

@@ -245,6 +245,21 @@ class AMQPConnection:
         # tail of the ordered background chain pipelining remote-push
         # round trips past the read loop (see _batch_barrier)
         self._remote_chain: Optional[asyncio.Task] = None
+        # multi-tenancy (chanamq_tpu/tenancy/): resolved once at
+        # Connection.Open from broker.tenancy. _throttled is the tenant's
+        # publish gate (token bucket drained / memory-share floor) and
+        # rides the same hold machinery as broker.blocked; _tenant_rated
+        # is the Tenant object ONLY when its quota declares a
+        # publish-rate, so the ungated publish hot path pays one
+        # attribute load + None check. ACL booleans are per-connection
+        # constants (user x vhost is fixed after Open); _can_write also
+        # gates the fused fast path so denials surface as proper 403s.
+        self.tenant = None
+        self._tenant_rated = None
+        self._throttled = False
+        self._can_configure = True
+        self._can_write = True
+        self._can_read = True
 
     # ------------------------------------------------------------------
     # output path
@@ -370,6 +385,8 @@ class AMQPConnection:
             if self._flow_stopped:
                 self.broker.metrics.flow_throttles += 1
         elif new < STAGE_THROTTLE and old >= STAGE_THROTTLE:
+            if self._throttled:
+                return  # tenant gate still closed: keep publishers stopped
             resumed = False
             for channel_id in self._flow_stopped:
                 if (channel_id in self.channels
@@ -379,6 +396,42 @@ class AMQPConnection:
             self._flow_stopped.clear()
             if resumed:
                 self.broker.metrics.flow_resumes += 1
+
+    def set_tenant_gate(self, on: bool) -> None:
+        """Tenant publish-gate transition (token bucket drained or
+        memory-share floor hit, tenancy/registry.py). Mirrors
+        _on_flow_stage: the gate itself is the hold interception in
+        _run_command / the fused-path check; Channel.Flow is the advisory
+        wire signal for publishers that honor it."""
+        if on == self._throttled:
+            return
+        self._throttled = on
+        if self.closing or not self._opened:
+            return
+        if on:
+            if not self._has_published:
+                return
+            for channel_id in self.channels:
+                if channel_id not in self._closing_channels:
+                    self.send_method(channel_id, am.Channel.Flow(active=False))
+                    self._flow_stopped.add(channel_id)
+        else:
+            if self.broker.blocked:
+                return  # broker ladder still throttling: keep them stopped
+            for channel_id in self._flow_stopped:
+                if (channel_id in self.channels
+                        and channel_id not in self._closing_channels):
+                    self.send_method(channel_id, am.Channel.Flow(active=True))
+            self._flow_stopped.clear()
+
+    def detach_tenant(self) -> None:
+        """Tenant removed at runtime: the connection stays open but loses
+        quota/ACL scoping (its vhost is no longer tenant-owned)."""
+        if self._throttled:
+            self.set_tenant_gate(False)
+        self.tenant = None
+        self._tenant_rated = None
+        self._can_configure = self._can_write = self._can_read = True
 
     def notify_consumer_cancel(self, channel: ServerChannel, tag: str) -> None:
         """Server-sent Basic.Cancel: the queue died under this consumer
@@ -471,16 +524,18 @@ class AMQPConnection:
             return False
         if command.channel in self._held:
             return True  # per-channel FIFO behind an already-held publish
-        if (self.broker.blocked
-                and method_type is am.Basic.Publish
-                and command.channel != 0):
-            # per-connection publish credit (chana.mq.flow.publish-credit):
-            # the first gated publishes spend a bounded byte allowance
-            # before the hard hold engages, so a well-behaved publisher
-            # that reacts to Channel.Flow(active=false) in time never
-            # parks at all. Credit 0 (the Broker default) holds
-            # immediately — the legacy gate contract.
-            return not self._spend_flow_credit(command)
+        if method_type is am.Basic.Publish and command.channel != 0:
+            if self.broker.blocked:
+                # per-connection publish credit
+                # (chana.mq.flow.publish-credit): the first gated publishes
+                # spend a bounded byte allowance before the hard hold
+                # engages, so a well-behaved publisher that reacts to
+                # Channel.Flow(active=false) in time never parks at all.
+                # Credit 0 (the Broker default) holds immediately — the
+                # legacy gate contract.
+                return not self._spend_flow_credit(command)
+            if self._throttled:
+                return not self._spend_tenant_credit(command)
         return False
 
     def _spend_flow_credit(self, command: AMQCommand) -> bool:
@@ -497,6 +552,38 @@ class AMQPConnection:
             return False
         self._flow_credit -= self._held_cost(command)
         return True
+
+    def _spend_tenant_credit(self, command: AMQCommand) -> bool:
+        """Tenant-gated twin of _spend_flow_credit: while the tenant's
+        publish gate is closed, the per-connection credit grant is drawn
+        from whatever tokens the tenant's bucket has re-accrued (capped at
+        the broker's flow grant), so the held stream drains at exactly the
+        quota rate instead of stalling until a full resume. Executed
+        publishes that pass here are pre-paid — the publish-site spend is
+        skipped while _throttled (see _tenant_spend)."""
+        tenant = self.tenant
+        if tenant is None or tenant.memory_gated:
+            # no tenant (gate mid-lift) executes; a memory-share floor
+            # never grants — only draining lifts it
+            return tenant is None
+        if not self._flow_credit:  # None or spent: draw a fresh grant
+            grant = tenant.take_credit(
+                self.broker.flow_publish_credit or self.PARK_BUF_MAX)
+            if grant <= 0:
+                return False
+            self._flow_credit = grant
+        self._flow_credit -= self._held_cost(command)
+        return True
+
+    def _tenant_spend(self, nbytes: int) -> None:
+        """Publish-site token spend (generic + fast paths; the fused path
+        inlines the same two lines). Accounted cost matches the held-cost
+        formula (body + flat per-command overhead) so empty-body floods
+        still drain the bucket. Skipped while gated: gated publishes that
+        execute pre-paid via _spend_tenant_credit."""
+        rated = self._tenant_rated
+        if rated is not None and not self._throttled:
+            rated.spend(nbytes + self.HELD_COMMAND_OVERHEAD)
 
     async def _release_held(self) -> bool:
         """Gate reopened: execute held commands, per-channel FIFO (channel
@@ -579,7 +666,7 @@ class AMQPConnection:
         # the Frame-object path
         scan = getattr(self._parser, "scan_batches", None)
         while not self.closing:
-            if self._held and not self.broker.blocked:
+            if self._held and not self.broker.blocked and not self._throttled:
                 # gate reopened: run the held publishes (per-channel FIFO)
                 if not await self._release_held():
                     return
@@ -588,14 +675,20 @@ class AMQPConnection:
             # reading (bytes back up into TCP). Liveness is unobservable
             # in this state, so the clock gets a BOUNDED grace — a peer
             # that stays unobservable past it is reaped by the heartbeat
-            # loop (VERDICT r4 weak #3: the grace must be capped).
-            while (self.broker.blocked and not self.closing
+            # loop (VERDICT r4 weak #3: the grace must be capped). The
+            # tenant gate has no event to wait on (it lifts on the next
+            # registry tick), so its park leg is a bounded sleep.
+            while ((self.broker.blocked or self._throttled)
+                   and not self.closing
                    and self._held_bytes >= self._held_cap()):
                 self._park_grace_tick()
-                await self.broker.wait_memory_gate()
+                if self.broker.blocked:
+                    await self.broker.wait_memory_gate()
+                else:
+                    await asyncio.sleep(0.25)
             if self.closing:
                 return
-            if self._held and not self.broker.blocked:
+            if self._held and not self.broker.blocked and not self._throttled:
                 continue  # gate just reopened: release before reading more
             if self._held:
                 # bounded read while holding: the loop must wake to release
@@ -659,7 +752,8 @@ class AMQPConnection:
                 ErrorCode.PRECONDITION_FAILED,
                 "memory overload: broker refusing publishes"))
             return not self.closing
-        if (self._held or self.broker.blocked) and self._should_hold(out):
+        if ((self._held or self.broker.blocked or self._throttled)
+                and self._should_hold(out)):
             self._hold_command(out)
             return True
         try:
@@ -722,7 +816,8 @@ class AMQPConnection:
                 off = offsets[i]
                 if (ftype == 1 and self._fast_path
                         and channel_id not in partials
-                        and not self._held and not self.broker.blocked):
+                        and not self._held and not self.broker.blocked
+                        and not self._throttled):
                     consumed = 0
                     try:
                         sig = raw[off:off + 4]
@@ -777,8 +872,10 @@ class AMQPConnection:
     def _fast_path(self) -> bool:
         # clustered connections take it too: _fused_publish falls back on
         # a cluster-route-cache miss, and _fused_ack settles through the
-        # same channel.ack the generic arm uses (remote settles buffer)
-        return self._opened and not self._closing_channels
+        # same channel.ack the generic arm uses (remote settles buffer).
+        # ACL write denial routes publishes to the generic path so each
+        # raises a proper access-refused channel error.
+        return self._opened and not self._closing_channels and self._can_write
 
     def _fused_publish(
         self, raw, i, n, types, channels, offsets, lengths
@@ -871,6 +968,12 @@ class AMQPConnection:
         # count the skip before publish: the except handlers in
         # _consume_scan resume past this publish's frames on soft errors
         self._fused_skip = consumed
+        rated = self._tenant_rated
+        if rated is not None:
+            # tenant publish-rate token spend (same cost formula as
+            # _held_cost); may close the tenant gate, which the scan-loop
+            # gate check observes before the NEXT frame
+            rated.spend(len(body) + self.HELD_COMMAND_OVERHEAD)
         broker = self.broker
         if broker.cluster is None:
             router = broker.router
@@ -1170,6 +1273,15 @@ class AMQPConnection:
             await self.writer.wait_closed()
         except Exception:
             pass
+        tenant = self.tenant
+        if tenant is not None:
+            # fold the per-connection counters into the tenant so its
+            # published/delivered series stay monotonic across churn
+            tenant.conns.discard(self)
+            tenant.published_folded += self.published_msgs
+            tenant.delivered_folded += self.delivered_msgs
+            self.tenant = None
+            self._tenant_rated = None
         self.broker.metrics.connections_closed += 1
         bus = events.ACTIVE
         if bus is not None and self._opened:
@@ -1305,10 +1417,17 @@ class AMQPConnection:
             if not self._tuned:
                 raise HardError(ErrorCode.COMMAND_INVALID, "tune-ok required first")
             vhost_name = method.virtual_host or "/"
+            registry = self.broker.tenancy
+            # tenant users are confined to their tenant's vhosts: the
+            # effective allowlist view merges the registry over the
+            # server-wide map (built per handshake, so POST /admin/tenants
+            # takes effect without a listener restart)
+            permissions = (self.permissions if registry is None
+                           else registry.auth_permissions(self.permissions))
             # allowlist BEFORE existence: a restricted user must not be
             # able to use the error-code difference as a vhost-name oracle
-            if (self.permissions is not None and self.username is not None):
-                allowed = self.permissions.get(self.username)
+            if (permissions is not None and self.username is not None):
+                allowed = permissions.get(self.username)
                 # a user absent from the map is unrestricted (allowlists
                 # are opt-in per user)
                 if allowed is not None and vhost_name not in allowed:
@@ -1322,6 +1441,23 @@ class AMQPConnection:
                 raise HardError(
                     ErrorCode.INVALID_PATH, f"no vhost '{vhost_name}'",
                     method.CLASS_ID, method.METHOD_ID)
+            if registry is not None:
+                refusal = registry.connection_refusal(vhost_name)
+                if refusal is not None:
+                    raise HardError(
+                        ErrorCode.NOT_ALLOWED, refusal,
+                        method.CLASS_ID, method.METHOD_ID)
+                tenant = registry.by_vhost.get(vhost_name)
+                if tenant is not None:
+                    self.tenant = tenant
+                    tenant.conns.add(self)
+                    if tenant.rated:
+                        self._tenant_rated = tenant
+                    if tenant.gated:
+                        self._throttled = True  # join an already-gated tenant
+                    (self._can_configure, self._can_write,
+                     self._can_read) = tenant.acl_for(
+                        self.username, vhost_name)
             self.vhost_name = vhost_name
             self._opened = True
             self.send_method(0, am.Connection.OpenOk())
@@ -1347,12 +1483,17 @@ class AMQPConnection:
         nothing; auth unimplemented there, README 'Status'). With
         chana.mq.auth.users configured, PLAIN verifies against the user
         table in constant time and EXTERNAL is refused (EXCEEDS the
-        reference)."""
+        reference). The effective table merges tenant users
+        (tenancy/registry.py) over the server-wide map, rebuilt per
+        handshake so runtime tenant changes apply immediately."""
+        registry = self.broker.tenancy
+        users = (self.users if registry is None
+                 else registry.auth_users(self.users))
         if mechanism == "PLAIN":
             parts = response.split(b"\x00")
             if len(parts) != 3:
                 return False
-            if self.users is None:
+            if users is None:
                 return True
             import hmac
 
@@ -1361,7 +1502,7 @@ class AMQPConnection:
                 password = parts[2].decode("utf-8")
             except UnicodeDecodeError:
                 return False
-            expected = self.users.get(user)
+            expected = users.get(user)
             # compare even for unknown users so a timing probe can't
             # enumerate the user table
             ok = hmac.compare_digest(
@@ -1372,7 +1513,7 @@ class AMQPConnection:
                 return True
             return False
         if mechanism == "EXTERNAL":
-            return self.users is None
+            return users is None
         return False
 
     # -- channel class -----------------------------------------------------
@@ -1389,6 +1530,14 @@ class AMQPConnection:
                 raise HardError(
                     ErrorCode.CHANNEL_ERROR, f"channel {cid} already open",
                     method.CLASS_ID, method.METHOD_ID)
+            if self.tenant is not None:
+                refusal = self.broker.tenancy.channel_refusal(self.tenant)
+                if refusal is not None:
+                    # connection exception, like RabbitMQ's channel-limit
+                    # refusal (530 not-allowed)
+                    raise HardError(
+                        ErrorCode.NOT_ALLOWED, refusal,
+                        method.CLASS_ID, method.METHOD_ID)
             self.channels[cid] = ServerChannel(self, cid)
             self.send_method(cid, am.Channel.OpenOk())
         elif isinstance(method, am.Channel.Flow):
@@ -1422,6 +1571,10 @@ class AMQPConnection:
         method = command.method
         cid = command.channel
         self._channel(command)
+        if (not self._can_configure
+                and isinstance(method, (am.Exchange.Declare,
+                                        am.Exchange.Delete))):
+            self._deny_acl("configure", method)
         if isinstance(method, am.Exchange.Declare):
             self.broker_check_name(method.exchange, method)
             await self.broker.declare_exchange(
@@ -1468,6 +1621,9 @@ class AMQPConnection:
         method = command.method
         cid = command.channel
         self._channel(command)
+        if (not self._can_configure
+                and isinstance(method, (am.Queue.Declare, am.Queue.Delete))):
+            self._deny_acl("configure", method)
         if isinstance(method, am.Queue.Declare):
             name = method.queue
             if not name:
@@ -1731,7 +1887,8 @@ class AMQPConnection:
         if (type(method) is not am.Basic.Publish
                 or self.broker.cluster is not None
                 or self._closing_channels
-                or not self._opened):
+                or not self._opened
+                or not self._can_write):
             return False
         channel = self.channels.get(command.channel)
         if channel is None:
@@ -1739,6 +1896,7 @@ class AMQPConnection:
         if channel.mode is ChannelMode.TX:
             return False  # transactional publish: _on_publish buffers it
         props = command.properties or BasicProperties()
+        self._tenant_spend(len(command.body or b""))
         seq = self._arm_confirm(channel)
         routed, deliverable = self.broker.publish_sync(
             self.vhost_name, method.exchange, method.routing_key,
@@ -1752,6 +1910,8 @@ class AMQPConnection:
         return True
 
     async def _on_publish(self, channel: ServerChannel, command: AMQCommand) -> None:
+        if not self._can_write:
+            self._deny_acl("write", command.method)
         if channel.mode is ChannelMode.TX:
             # transactional publish: buffer until tx.commit. The body counts
             # against the broker memory gate while parked (a flood inside a
@@ -1768,6 +1928,7 @@ class AMQPConnection:
             # drain the buffered pipeline first so per-queue FIFO holds
             await self._drain_remote()
         props = command.properties or BasicProperties()
+        self._tenant_spend(len(command.body or b""))
         seq = self._arm_confirm(channel)
         buffered_before = len(self._remote_pending)
         routed, deliverable = await self.broker.publish(
@@ -1783,7 +1944,19 @@ class AMQPConnection:
             self._remote_strict = True
         self._publish_aftermath(channel, command, props, routed, deliverable, seq)
 
+    def _deny_acl(self, perm: str, method: am.Method) -> None:
+        """ACL denial -> AMQP access-refused (403, soft): the channel
+        closes, the connection survives (RabbitMQ's mapping)."""
+        self.broker.metrics.tenancy_acl_denials_total += 1
+        raise ChannelError(
+            ErrorCode.ACCESS_REFUSED,
+            f"ACL: user '{self.username}' lacks {perm} permission on "
+            f"vhost '{self.vhost_name}'",
+            method.CLASS_ID, method.METHOD_ID)
+
     async def _on_consume(self, channel: ServerChannel, method: am.Basic.Consume) -> None:
+        if not self._can_read:
+            self._deny_acl("read", method)
         tag = method.consumer_tag or f"ctag-{self.id}-{channel.id}-{len(channel.consumers) + 1}"
         if tag in channel.consumers:
             raise ChannelError(
@@ -1858,6 +2031,8 @@ class AMQPConnection:
         queue.add_consumer(consumer)
 
     async def _on_get(self, channel: ServerChannel, method: am.Basic.Get) -> None:
+        if not self._can_read:
+            self._deny_acl("read", method)
         site, queue = self.broker.queue_site(self.vhost_name, method.queue, self.id)
         if site == "activate":
             queue = await self.broker.activate_queue(self.vhost_name, method.queue)

@@ -459,6 +459,14 @@ async def run_node(config) -> None:
                     # event a consumer can alert on
                     bus.emit("shard.restarted", {
                         "shard": shard_index, "restarts": restarts})
+        # tenant registry (fifth ACTIVE-gate subsystem): installed before
+        # the listeners open so the first handshake already authenticates
+        # against tenant user tables and lands under quota enforcement.
+        # Called unconditionally: the enable path itself fail-closes when
+        # tenants are declared while chana.mq.tenant.enabled is false.
+        from .. import tenancy as tenancy_mod
+
+        tenancy_mod.enable_from_config(config, server.broker)
         if config.bool("chana.mq.cluster.enabled"):
             from ..cluster.node import ClusterNode
 
@@ -542,11 +550,15 @@ async def run_node(config) -> None:
             if config.bool("chana.mq.slo.enabled"):
                 # burn-rate SLOs ride the telemetry tick (slo/): specs
                 # from chana.mq.slo.* or POST /admin/slo/configure
-                from ..slo import engine_from_config
+                from ..slo import attach_tenant_latency, engine_from_config
 
-                telemetry.set_slo(engine_from_config(
+                engine = engine_from_config(
                     config,
-                    config.duration_s("chana.mq.telemetry.interval") or 1.0))
+                    config.duration_s("chana.mq.telemetry.interval") or 1.0)
+                telemetry.set_slo(engine)
+                # tenant-scoped delivery-latency SLOs need their per-tenant
+                # histogram allocated before the first delivery
+                attach_tenant_latency(engine, server.broker.tenancy)
             server.broker.telemetry = telemetry
             await telemetry.start()
         if config.bool("chana.mq.forecast.enabled"):

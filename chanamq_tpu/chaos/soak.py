@@ -2147,3 +2147,518 @@ async def _key_shared_group_check(srv, qname: str, violations: list[str]) -> dic
                 await conn.close()
             except Exception:
                 pass
+
+
+async def _tenant_run(seed: int) -> dict:
+    """One noisy-neighbor episode on a three-tenant node. Returns a report
+    plus the normalized tenancy decision-log bytes for same-seed
+    comparison (run_tenant_soak runs this twice).
+
+    Cast: ``aggr`` floods past a publish-rate quota (token bucket sized so
+    the bucket gates on exactly the 16th publish and each registry tick
+    refills exactly 8 publishes' worth of tokens); ``vict`` has no quota
+    and must see clean paced latency, an untouched SLO budget, and a
+    tenant-filtered firehose while the aggressor is parked; ``mem``
+    breaches a memory-share floor with a confirmed backlog and only a
+    drain lifts it. Every registry tick is harness-driven (the broker
+    sweep is parked at 1 h), so the decision log is a pure function of
+    message counts — byte-identical across same-seed runs."""
+    import hashlib
+    import json as json_mod
+
+    from .. import events as events_mod
+    from .. import tenancy as tenancy_mod
+    from ..broker.broker import Broker
+    from ..broker.server import BrokerServer
+    from ..client.client import AMQPClient
+    from ..events.bus import EventBus, Firehose
+    from ..slo import SLOSpec, attach_tenant_latency
+    from ..slo.engine import SLOEngine
+    from ..store.memory import MemoryStore
+    from ..telemetry import TelemetryService
+    from ..telemetry.alerts import default_rules as alert_defaults
+    from ..tenancy.registry import TenantRegistry
+
+    BODY = 1024
+    COST = BODY + 512            # held-cost formula: body + flat overhead
+    RATE = 8 * COST              # refill: exactly 8 publishes per tick
+    BURST = 16 * COST            # bucket: the 16th publish closes the gate
+    rounds = 2 + seed % 3        # drain rounds (8 held publishes each)
+    extra = 8 * rounds           # flood depth beyond the gate
+    MEM_BODY = 2048
+    HIGH = 256 * 1024            # memory high watermark the shares read
+    # mem's share = 65536: 40 x 2048 = 81920 breaches it; exit at 52428
+
+    violations: list[str] = []
+
+    async def until(predicate, timeout, what):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while not predicate():
+            if asyncio.get_event_loop().time() > deadline:
+                violations.append(f"timeout waiting for {what}")
+                return False
+            await asyncio.sleep(0.02)
+        return True
+
+    broker = Broker(store=MemoryStore(),
+                    message_sweep_interval_s=3600.0,  # manual ticks only
+                    memory_high_watermark=HIGH,
+                    flow_high_watermark=8 << 20)  # node ladder stays at 0
+    # base (non-tenant) operator account: tenant users are confined to
+    # their tenant's vhosts, so the "/" event/firehose consumer needs a
+    # server-wide identity once tenant users force authentication on
+    srv = BrokerServer(broker=broker, host="127.0.0.1", port=0,
+                       heartbeat_s=0, users={"ops": "ops-pw"})
+    registry = TenantRegistry(broker)
+    registry.define("aggr", {
+        "vhosts": ["vaggr"], "users": {"aggr": "pw-a"},
+        "quota": {"publish-rate": RATE, "publish-burst": BURST}})
+    registry.define("vict", {"vhosts": ["vvict"], "users": {"vict": "pw-v"}})
+    registry.define("mem", {
+        "vhosts": ["vmem"], "users": {"mem": "pw-m"},
+        "quota": {"memory-share": 0.25}})
+    broker.tenancy = registry
+    tenancy_mod.install(registry)
+
+    # tenant-scoped SLOs: vict's latency objective gets its own histogram
+    # (attach_tenant_latency) and an independent error budget the
+    # aggressor must not be able to burn
+    specs = [
+        SLOSpec("vict-latency", "delivery-latency", threshold_ms=250.0,
+                fast_windows=(5, 30), slow_windows=(60, 240),
+                budget_window=240, tenant="vict"),
+        SLOSpec("vict-publish", "publish-success",
+                fast_windows=(5, 30), slow_windows=(60, 240),
+                budget_window=240, tenant="vict"),
+    ]
+    engine = SLOEngine(specs)
+    svc = TelemetryService(
+        broker, interval_s=1.0, ring_ticks=64,
+        rules=alert_defaults(backlog_growth=1e12, stall_ticks=10**6,
+                             repl_lag=1e12, loop_lag_ms=1e12,
+                             memory_stage=1e12),
+        slo=engine)
+    broker.telemetry = svc
+    attach_tenant_latency(engine, registry)
+
+    conns: list = []
+    bus_events: list[dict] = []
+    taps: list = []
+    try:
+        await srv.start()
+        for vh in ("vaggr", "vvict", "vmem"):
+            await broker.create_vhost(vh)
+
+        # -- observability consumers FIRST (ops identity on "/"): the
+        #    decision stream, one tenant-scoped union binding, and the
+        #    vict-filtered firehose
+        ops = await AMQPClient.connect(
+            "127.0.0.1", srv.bound_port, username="ops", password="ops-pw")
+        conns.append(ops)
+        ech = await ops.channel()
+        await ech.queue_declare("tev", exclusive=True)
+        await ech.queue_bind("tev", "amq.chanamq.event", "tenant.throttle.*")
+        await ech.queue_bind("tev", "amq.chanamq.event", "tenant.resume.*")
+        await ech.queue_bind("tev", "amq.chanamq.event",
+                             "tenant.aggr.queue.declared")
+
+        def on_event(msg):
+            bus_events.append(json_mod.loads(bytes(msg.body)))
+            ech.basic_ack(msg.delivery_tag)
+
+        await ech.basic_consume("tev", on_event, consumer_tag="soak-ev")
+
+        fch = await ops.channel()
+        await fch.queue_declare("tfh", exclusive=True)
+        await fch.queue_bind("tfh", "amq.chanamq.trace", "publish.#")
+        await fch.queue_bind("tfh", "amq.chanamq.trace", "publish")
+        await fch.queue_bind("tfh", "amq.chanamq.trace", "deliver.#")
+
+        def on_tap(msg):
+            taps.append(msg.routing_key)
+            fch.basic_ack(msg.delivery_tag)
+
+        await fch.basic_consume("tfh", on_tap, consumer_tag="soak-fh")
+        events_mod.install(EventBus(broker),
+                           Firehose(broker, tenant_filter="vict"))
+
+        # -- aggressor: 16 paced publishes exactly drain the burst; the
+        #    16th spend lands tokens on 0 and closes the gate
+        aggr = await AMQPClient.connect(
+            "127.0.0.1", srv.bound_port, vhost="vaggr",
+            username="aggr", password="pw-a")
+        conns.append(aggr)
+        ach = await aggr.channel()
+        await ach.confirm_select()
+        await ach.queue_declare("aq")
+        for i in range(16):
+            ach.basic_publish(b"a" * BODY, routing_key="aq")
+            await ach.wait_unconfirmed_below(1, timeout=10)
+        aggr_t = registry.tenants["aggr"]
+        if not aggr_t.rate_gated:
+            violations.append("aggressor bucket did not gate on the 16th "
+                              f"publish (tokens={aggr_t.tokens})")
+        # published=15: the counter increments after the gating spend, so
+        # the 16th publish is in flight when the throttle is ledgered
+        if not registry.decision_log or registry.decision_log[0] != {
+                "decision": "throttle", "tenant": "aggr",
+                "reason": "publish-rate", "tick": 0, "tokens": 0,
+                "resident": 0, "floor": 0, "published": 15}:
+            violations.append(
+                f"unexpected first decision: {registry.decision_log[:1]}")
+
+        # flood past the gate: every one of these parks at the hold gate
+        for _ in range(extra):
+            ach.basic_publish(b"a" * BODY, routing_key="aq")
+
+        def held_publishes(tenant):
+            # only publishes: the client's FlowOk reply to the advisory
+            # Channel.Flow can FIFO-park behind a held publish too
+            return sum(
+                1 for c in tenant.conns for cmds in c._held.values()
+                for cmd in cmds if type(cmd.method).__name__ == "Publish")
+
+        await until(lambda: held_publishes(aggr_t) == extra, 10,
+                    f"{extra} held aggressor publishes")
+
+        # -- victim, while the aggressor is parked: paced publish->deliver
+        #    latency plus its own SLO budget must be untouched
+        vict = await AMQPClient.connect(
+            "127.0.0.1", srv.bound_port, vhost="vvict",
+            username="vict", password="pw-v")
+        conns.append(vict)
+        vch = await vict.channel()
+        await vch.confirm_select()
+        await vch.queue_declare("vq")
+        loop = asyncio.get_event_loop()
+        lat: list[float] = []
+        got = asyncio.Event()
+
+        def on_vict(msg):
+            lat.append(loop.time() - t0)
+            vch.basic_ack(msg.delivery_tag)
+            got.set()
+
+        await vch.basic_consume("vq", on_vict, consumer_tag="v")
+        svc.sample_tick(1.0)  # latency baseline tick (delta buckets)
+        for i in range(24):
+            got.clear()
+            t0 = loop.time()
+            vch.basic_publish(b"v" * BODY, routing_key="vq")
+            await asyncio.wait_for(got.wait(), 10)
+        svc.sample_tick(1.0)
+        svc.sample_tick(1.0)
+        p99 = sorted(lat)[max(0, int(len(lat) * 0.99) - 1)]
+        if p99 > 0.25:
+            violations.append(
+                f"victim paced p99 {p99 * 1000:.1f} ms > 250 ms while the "
+                "aggressor was parked")
+        budgets = engine.readiness_stamp()["budget_remaining"]
+        for name in ("vict-latency", "vict-publish"):
+            if budgets.get(name) != 1.0:
+                violations.append(
+                    f"victim SLO budget burned: {name}={budgets.get(name)}")
+
+        # -- drain: each tick refills exactly 8 publishes' tokens -> the
+        #    gate lifts, 8 held publishes release and re-close it
+        for r in range(1, rounds + 1):
+            registry.tick(1.0)
+            remaining = extra - 8 * r
+            await until(lambda want=remaining:
+                        len(ach.unconfirmed) == want, 10,
+                        f"drain round {r}: {remaining} unconfirmed left")
+        registry.tick(1.0)  # final refill lifts the gate for good
+        if aggr_t.gated:
+            violations.append("aggressor still gated after the final tick")
+        if aggr_t.throttles != rounds + 1:
+            violations.append(
+                f"aggressor throttles {aggr_t.throttles} != {rounds + 1}")
+
+        # zero confirmed loss through the gate: everything the aggressor
+        # ever published is consumable
+        a_got: set[int] = set()
+        a_done = asyncio.Event()
+
+        def on_aggr(msg):
+            a_got.add(msg.delivery_tag)
+            ach.basic_ack(msg.delivery_tag)
+            if len(a_got) >= 16 + extra:
+                a_done.set()
+
+        await ach.basic_consume("aq", on_aggr, consumer_tag="a")
+        try:
+            await asyncio.wait_for(a_done.wait(), 15)
+        except asyncio.TimeoutError:
+            violations.append(
+                f"aggressor drained only {len(a_got)}/{16 + extra} after "
+                "the gate lifted")
+
+        # -- memory-share floor: a confirmed 80 KiB backlog breaches mem's
+        #    64 KiB share at the next tick; held publishes stay parked (a
+        #    memory floor never grants credit) until a consumer drains it
+        mem = await AMQPClient.connect(
+            "127.0.0.1", srv.bound_port, vhost="vmem",
+            username="mem", password="pw-m")
+        conns.append(mem)
+        mch = await mem.channel()
+        await mch.confirm_select()
+        await mch.queue_declare("mq")
+        for _ in range(40):
+            mch.basic_publish(b"m" * MEM_BODY, routing_key="mq")
+        await mch.wait_unconfirmed_below(1, timeout=10)
+        mem_t = registry.tenants["mem"]
+        registry.tick(1.0)
+        if not mem_t.memory_gated:
+            violations.append(
+                f"memory share not gated at {mem_t.resident_bytes} resident")
+        for _ in range(8):
+            mch.basic_publish(b"m" * MEM_BODY, routing_key="mq")
+
+        await until(lambda: held_publishes(mem_t) == 8, 10,
+                    "8 held mem publishes")
+        registry.tick(1.0)
+        if not mem_t.memory_gated:
+            violations.append("memory floor lifted without a drain")
+
+        m_count = 0
+        m_done = asyncio.Event()
+
+        # a second channel: the consume must not queue behind the held
+        # publishes (holds are per-channel FIFO by design)
+        mch2 = await mem.channel()
+
+        def on_mem(msg):
+            nonlocal m_count
+            m_count += 1
+            mch2.basic_ack(msg.delivery_tag)
+            if m_count >= 48:
+                m_done.set()
+
+        await mch2.basic_consume("mq", on_mem, consumer_tag="m")
+        await until(lambda: registry.tenant_resident_bytes(mem_t) == 0,
+                    15, "mem backlog drain")
+        registry.tick(1.0)  # resident back under the exit ratio: resume
+        if mem_t.memory_gated:
+            violations.append("memory floor still pinned after the drain")
+        try:
+            await asyncio.wait_for(m_done.wait(), 15)
+        except asyncio.TimeoutError:
+            violations.append(
+                f"mem delivered only {m_count}/48 after the floor lifted")
+
+        # -- event-bus and firehose assertions (delivery is async: give
+        #    the streams a bounded settle window)
+        expected_events = 2 * rounds + 5
+        await until(lambda: len(bus_events) >= expected_events, 10,
+                    f"{expected_events} bus events")
+        decisions = [ev["event"] for ev in bus_events
+                     if not ev["event"].startswith("tenant.aggr.queue")
+                     and ev["event"] != "queue.declared"]
+        want = (["tenant.throttle.aggr"]
+                + ["tenant.resume.aggr", "tenant.throttle.aggr"] * rounds
+                + ["tenant.resume.aggr", "tenant.throttle.mem",
+                   "tenant.resume.mem"])
+        if decisions != want:
+            violations.append(
+                f"decision event stream mismatch: {decisions} != {want}")
+        union = [ev for ev in bus_events if ev["event"] == "queue.declared"]
+        if len(union) != 1 or union[0].get("tenant") != "aggr" \
+                or union[0].get("queue") != "aq":
+            violations.append(
+                f"tenant-scoped union route broken: {union}")
+        if any(".vict" in ev["event"] for ev in bus_events):
+            violations.append("victim tenant saw gate decisions")
+        await until(lambda: len(taps) >= 48, 10, "48 firehose taps")
+        bad_taps = [t for t in taps if t not in ("publish", "deliver.vq")]
+        if bad_taps:
+            violations.append(
+                f"vict-filtered firehose tapped foreign traffic: "
+                f"{sorted(set(bad_taps))}")
+        if taps.count("deliver.vq") != 24 or taps.count("publish") != 24:
+            violations.append(
+                f"firehose tap counts off: {len(taps)} total, "
+                f"{taps.count('deliver.vq')} delivers")
+
+        log_blob = json_mod.dumps(
+            registry.decision_log, separators=(",", ":"),
+            sort_keys=True).encode()
+        return {
+            "seed": seed,
+            "rounds": rounds,
+            "aggr_published": aggr_t.published_total(),
+            "aggr_throttles": aggr_t.throttles,
+            "victim_p99_ms": round(p99 * 1000, 2),
+            "victim_budgets": {k: budgets.get(k) for k in budgets},
+            "mem_throttles": mem_t.throttles,
+            "decisions": len(registry.decision_log),
+            "bus_events": len(bus_events),
+            "firehose_taps": len(taps),
+            "log_sha256": hashlib.sha256(log_blob).hexdigest(),
+            "log_bytes": log_blob,
+            "violations": violations,
+        }
+    finally:
+        events_mod.install(None)
+        tenancy_mod.install(None)
+        for conn in conns:
+            try:
+                await conn.close()
+            except Exception:
+                pass
+        try:
+            await srv.stop()
+        except Exception:
+            pass
+
+
+async def run_tenant_soak(seed: int) -> dict:
+    """Noisy-neighbor tenancy soak (``bench.py --tenant``): the seeded
+    three-tenant episode run TWICE with the same seed. ``violations`` is
+    empty iff every run held:
+
+    1. **Quota throttles the aggressor, not the victim** — the token
+       bucket gates on exactly the 16th publish, each registry tick
+       releases exactly 8 held publishes, and the victim's paced p99
+       stays under 250 ms with its tenant SLO budgets at 1.0.
+    2. **Zero confirmed loss through the gates** — every held publish is
+       eventually released, confirmed and consumable.
+    3. **The memory-share floor is drain-lifted only** — held publishes
+       never execute while the floor is pinned.
+    4. **Tenant-scoped observability is exact** — the decision event
+       stream, the ``tenant.<name>.*`` union route and the
+       tenant-filtered firehose each carry exactly the expected traffic.
+    5. **The decision log is deterministic** — the two runs' normalized
+       logs compare byte-identical, and non-trivially.
+    """
+    first = await _tenant_run(seed)
+    second = await _tenant_run(seed)
+    violations = list(first.pop("violations"))
+    violations.extend(second.pop("violations"))
+    log1 = first.pop("log_bytes")
+    log2 = second.pop("log_bytes")
+    if not log1:
+        violations.append("first run produced an empty decision log")
+    if log1 != log2:
+        violations.append("same-seed tenancy decision logs differ")
+    return {
+        "seed": seed,
+        "runs": [first, second],
+        "log_sha256": first.get("log_sha256"),
+        "violations": violations,
+    }
+
+
+async def run_tenant_churn(cycles: int = 10000, *,
+                           amqp_every: int = 100) -> dict:
+    """Tenant-churn leak check (``bench.py --tenant-churn``): ``cycles``
+    define/remove rounds against a live registry — every ``amqp_every``-th
+    round also creates the tenant's vhost, authenticates as its user,
+    declares/publishes confirmed, disconnects and deletes the vhost. At
+    the end every registry index, auth view, accounted byte and vhost
+    must be exactly back at baseline: a surviving slot is a leak in the
+    define/remove or detach bookkeeping."""
+    from .. import tenancy as tenancy_mod
+    from ..broker.broker import Broker
+    from ..broker.server import BrokerServer
+    from ..client.client import AMQPClient
+    from ..store.memory import MemoryStore
+    from ..tenancy.registry import TenantRegistry
+
+    broker = Broker(store=MemoryStore(), message_sweep_interval_s=3600.0,
+                    flow_high_watermark=8 << 20)
+    srv = BrokerServer(broker=broker, host="127.0.0.1", port=0,
+                       heartbeat_s=0)
+    registry = TenantRegistry(broker)
+    broker.tenancy = registry
+    tenancy_mod.install(registry)
+    violations: list[str] = []
+    baseline_vhosts = None
+    amqp_cycles = 0
+    try:
+        await srv.start()
+        baseline_vhosts = set(broker.vhosts)
+        for i in range(cycles):
+            name, vh, user = f"t{i}", f"vt{i}", f"u{i}"
+            tenant = registry.define(name, {
+                "vhosts": [vh], "users": {user: f"pw{i}"},
+                "acls": {user: {vh: ["configure", "write", "read"]}},
+                "quota": {"publish-rate": 4096, "max-queues": 4}})
+            if i % amqp_every == 0:
+                await broker.create_vhost(vh)
+                conn = await AMQPClient.connect(
+                    "127.0.0.1", srv.bound_port, vhost=vh,
+                    username=user, password=f"pw{i}")
+                try:
+                    if len(tenant.conns) != 1:
+                        violations.append(
+                            f"cycle {i}: authenticated connection not "
+                            f"attached ({len(tenant.conns)} attached)")
+                    ch = await conn.channel()
+                    await ch.confirm_select()
+                    await ch.queue_declare(f"q{i}")
+                    for _ in range(3):
+                        ch.basic_publish(b"t" * 512, routing_key=f"q{i}")
+                    await ch.wait_unconfirmed_below(1, timeout=10)
+                    # explicit delete: vhost teardown drops structures but
+                    # the accounting gate is the queue-deletion path
+                    await ch.queue_delete(f"q{i}")
+                finally:
+                    await conn.close()
+                deadline = asyncio.get_event_loop().time() + 10
+                while tenant.conns and \
+                        asyncio.get_event_loop().time() < deadline:
+                    await asyncio.sleep(0.005)
+                if tenant.conns:
+                    violations.append(
+                        f"cycle {i}: connection never detached")
+                    break
+                await broker.delete_vhost(vh)
+                amqp_cycles += 1
+            if not registry.remove(name):
+                violations.append(f"cycle {i}: remove({name!r}) missed")
+                break
+
+        # settle: every registry slot, auth view and accounted byte must
+        # be exactly at baseline
+        if registry.tenants or registry.by_vhost or registry.by_user:
+            violations.append(
+                f"registry slots leaked: {len(registry.tenants)} tenants, "
+                f"{len(registry.by_vhost)} vhosts, "
+                f"{len(registry.by_user)} users")
+        if registry.auth_users(None) is not None:
+            violations.append("auth_users view retains churned users")
+        if registry.auth_permissions(None) is not None:
+            violations.append("auth_permissions view retains allowlists")
+        leaked = broker.resident_bytes + broker.held_bytes
+        if leaked:
+            violations.append(
+                f"accounted-bytes leak: resident={broker.resident_bytes} "
+                f"held={broker.held_bytes}")
+        if set(broker.vhosts) != baseline_vhosts:
+            violations.append(
+                f"vhosts not at baseline: "
+                f"{sorted(set(broker.vhosts) - baseline_vhosts)}")
+        if registry.decision_log:
+            violations.append(
+                f"{len(registry.decision_log)} spurious gate decisions "
+                "during churn")
+        if broker.metrics.tenancy_quota_refusals_total:
+            violations.append(
+                f"{broker.metrics.tenancy_quota_refusals_total} spurious "
+                "quota refusals during churn")
+        return {
+            "cycles": cycles,
+            "amqp_cycles": amqp_cycles,
+            "leaked_bytes": leaked,
+            "live_vhosts": len(broker.vhosts),
+            "registry_slots": (len(registry.tenants)
+                               + len(registry.by_vhost)
+                               + len(registry.by_user)),
+            "violations": violations,
+        }
+    finally:
+        tenancy_mod.install(None)
+        try:
+            await srv.stop()
+        except Exception:
+            pass

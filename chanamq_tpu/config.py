@@ -368,6 +368,18 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.firehose.enabled": False,
     "chana.mq.firehose.vhost": "/",
     "chana.mq.firehose.queue-filter": "",
+    # tenant-filter sibling of queue-filter: when set, only taps whose
+    # vhost belongs to the named tenant are republished (requires
+    # chana.mq.tenant.enabled).
+    "chana.mq.firehose.tenant": "",
+    # multi-tenancy (chanamq_tpu/tenancy/): tenants map is
+    # {"name": {"vhosts": [...], "users": {...}, "acls": {...},
+    #  "quota": {"max-connections": N, ..., "memory-share": 0.25,
+    #  "publish-rate": bytes/s, "publish-burst": bytes}} — see
+    # tenancy.registry for the full spec. Tenants declared while
+    # enabled=false are a boot error (fail closed, like auth knobs).
+    "chana.mq.tenant.enabled": False,
+    "chana.mq.tenant.tenants": None,
     # SLO engine (chanamq_tpu/slo/): burn-rate error budgets over the
     # telemetry tick (requires chana.mq.telemetry.enabled). Default specs
     # cover publish availability, delivery success, readiness, and
@@ -429,7 +441,8 @@ def _env_key(path: str) -> str:
 # keys whose VALUE is a mapping: flattening stops here so a config file's
 # {"auth": {"users": {...}}} arrives as one dict, not per-user leaf keys
 _DICT_LEAF_KEYS = frozenset(
-    {"chana.mq.auth.users", "chana.mq.auth.permissions"})
+    {"chana.mq.auth.users", "chana.mq.auth.permissions",
+     "chana.mq.tenant.tenants"})
 
 
 def _flatten(tree: Mapping[str, Any], prefix: str = "") -> dict[str, Any]:

@@ -67,6 +67,7 @@ def enable_from_config(config, broker):
             broker,
             vhost=config.str("chana.mq.firehose.vhost") or "/",
             queue_filter=config.str("chana.mq.firehose.queue-filter") or "",
+            tenant_filter=config.str("chana.mq.firehose.tenant") or "",
         )
     install(bus, firehose)
     return bus, firehose

@@ -72,6 +72,17 @@ class EventBus:
                 metrics.events_dropped_total += 1
                 return False
             names = exchange.matcher.route(routing_key)
+            # tenant-scoped subscriptions: when the event's vhost belongs
+            # to a tenant, the same event ALSO routes under
+            # tenant.<name>.<key> — one extra trie walk, only for events
+            # carrying a tenant-owned vhost, and only when tenancy is on
+            tenant = None
+            registry = getattr(broker, "tenancy", None)
+            if registry is not None:
+                tenant = registry.tenant_of_vhost(payload.get("vhost"))
+                if tenant is not None:
+                    names = names | exchange.matcher.route(
+                        f"tenant.{tenant}.{routing_key}")
             queues = [vhost.queues[n] for n in names if n in vhost.queues]
             if not queues:
                 # nothing bound (or bound queues not local): O(1) drop —
@@ -81,9 +92,13 @@ class EventBus:
             self.seq += 1
             # envelope fields win over payload keys of the same name (an
             # alert payload carries its own "event": fired/resolved)
+            envelope = {**payload, "event": routing_key,
+                        "node": broker.trace_node,
+                        "seq": self.seq, "ts": round(time.time(), 3)}
+            if tenant is not None:
+                envelope["tenant"] = tenant
             body = json.dumps(
-                {**payload, "event": routing_key, "node": broker.trace_node,
-                 "seq": self.seq, "ts": round(time.time(), 3)},
+                envelope,
                 separators=(",", ":"), sort_keys=True, default=str,
             ).encode()
             props = BasicProperties(
@@ -141,10 +156,14 @@ class Firehose:
     """
 
     def __init__(self, broker, vhost: str = "/",
-                 queue_filter: str = "") -> None:
+                 queue_filter: str = "", tenant_filter: str = "") -> None:
         self.broker = broker
         self.vhost = vhost
         self.queue_filter = queue_filter
+        # chana.mq.firehose.tenant: narrow the tap to traffic on vhosts
+        # owned by one tenant (resolved live against broker.tenancy, so
+        # runtime tenant changes apply to the next tap)
+        self.tenant_filter = tenant_filter
         # ``tap_bindings`` is the hot-path gate both seams read before
         # calling into the firehose at all: the trace exchange matcher's
         # live binding table (identity-stable, mutated in place), so an
@@ -193,6 +212,12 @@ class Firehose:
             log.debug("firehose tap failed for %s", routing_key,
                       exc_info=True)
 
+    def _tenant_owns(self, vhost_name: str) -> bool:
+        registry = getattr(self.broker, "tenancy", None)
+        return (registry is not None
+                and registry.tenant_of_vhost(vhost_name)
+                == self.tenant_filter)
+
     def tap_publish(self, exchange_name: str, routing_key: str,
                     body: bytes, queues: list) -> None:
         """Called from Broker.push_local after the normal enqueues (only
@@ -202,18 +227,26 @@ class Firehose:
         if self.queue_filter and not any(
                 q.name.startswith(self.queue_filter) for q in queues):
             return
+        if self.tenant_filter and not (
+                queues and self._tenant_owns(queues[0].vhost)):
+            # push_local enqueues within one vhost: the first queue's
+            # vhost is the publish's vhost
+            return
         key = f"publish.{exchange_name}" if exchange_name else "publish"
         self._tap(key, body, {
             "exchange": exchange_name, "routing_key": routing_key,
             "node": self.broker.trace_node})
 
     def tap_deliver(self, queue_name: str, exchange_name: str,
-                    routing_key: str, body: bytes) -> None:
+                    routing_key: str, body: bytes,
+                    vhost_name: str = "") -> None:
         """Called from ServerChannel.deliver as the frame is rendered
         (only when ``tap_bindings`` is truthy — the seam checks)."""
         if exchange_name.startswith("amq.chanamq."):
             return
         if self.queue_filter and not queue_name.startswith(self.queue_filter):
+            return
+        if self.tenant_filter and not self._tenant_owns(vhost_name):
             return
         self._tap(f"deliver.{queue_name}", body, {
             "queue": queue_name, "exchange": exchange_name,

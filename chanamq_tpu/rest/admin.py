@@ -242,6 +242,13 @@ class AdminServer:
             return ("POST", lambda: self._slo_configure(body))
         if rest == ["events"]:
             return ("GET", self._events_status)
+        if rest == ["tenants"]:
+            return ({"GET": self._tenants,
+                     "POST": lambda: self._tenant_put(body)}, None)
+        if len(rest) == 2 and rest[0] == "tenants":
+            return ("GET", lambda: self._tenant_detail(rest[1]))
+        if len(rest) == 3 and rest[0] == "tenants" and rest[2] == "delete":
+            return ("POST", lambda: self._tenant_delete(rest[1]))
         return None
 
     @staticmethod
@@ -465,7 +472,9 @@ class AdminServer:
         reset with the specs (they are properties of the objective, not
         of the process). Installs onto a telemetry service booted without
         SLOs too — the next tick starts evaluating."""
-        from ..slo import SLOEngine, default_slos, specs_from_json
+        from ..slo import (
+            SLOEngine, attach_tenant_latency, default_slos, specs_from_json,
+        )
 
         svc = self._svc()
         try:
@@ -481,8 +490,63 @@ class AdminServer:
         except ValueError as exc:
             raise AdminError("400 Bad Request", str(exc))
         svc.set_slo(engine)
+        attach_tenant_latency(engine, self.broker.tenancy)
         return {"ok": True,
                 "slos": [spec.name for spec in engine.specs]}
+
+    # -- multi-tenancy (chanamq_tpu/tenancy/) -------------------------------
+
+    def _tenancy(self):
+        registry = self.broker.tenancy
+        if registry is None:
+            raise AdminError(
+                "409 Conflict",
+                "tenancy disabled: boot with chana.mq.tenant.enabled")
+        return registry
+
+    def _tenants(self) -> dict:
+        """Registry snapshot: every tenant's quotas, live resource counts,
+        token-bucket level and gate state."""
+        return self._tenancy().snapshot()
+
+    def _tenant_put(self, body: bytes) -> dict:
+        """Define (or replace) one tenant at runtime. Body is the same
+        spec shape chana.mq.tenant.tenants takes, plus a "name" key:
+        {"name": "...", "vhosts": [...], "users": {...}, "acls": {...},
+        "quota": {...}}. New users/ACLs apply from the next handshake."""
+        from ..tenancy import TenancyError
+
+        registry = self._tenancy()
+        try:
+            req = json.loads(body or b"{}")
+        except ValueError as exc:
+            raise AdminError("400 Bad Request", f"bad json: {exc}")
+        if not isinstance(req, dict) or not isinstance(req.get("name"), str) \
+                or not req["name"]:
+            raise AdminError("400 Bad Request",
+                             'body must be an object with a "name" string')
+        spec = {k: v for k, v in req.items() if k != "name"}
+        try:
+            tenant = registry.define(req["name"], spec)
+        except TenancyError as exc:
+            raise AdminError("400 Bad Request", str(exc))
+        return {"ok": True, "tenant": tenant.snapshot()}
+
+    def _tenant_detail(self, name: str) -> dict:
+        registry = self._tenancy()
+        tenant = registry.tenants.get(name)
+        if tenant is None:
+            raise AdminError("404 Not Found", f"unknown tenant {name!r}")
+        return tenant.snapshot()
+
+    def _tenant_delete(self, name: str) -> dict:
+        """Remove a tenant: gates lift, connections detach (and stay open
+        — removal revokes quotas, not sessions), vhosts/users return to
+        the global namespace."""
+        registry = self._tenancy()
+        if not registry.remove(name):
+            raise AdminError("404 Not Found", f"unknown tenant {name!r}")
+        return {"ok": True, "tenant": name}
 
     def _events_status(self) -> dict:
         """Event-bus + firehose status: installed?, exchanges, publish /
@@ -708,6 +772,8 @@ class AdminServer:
         "events_published_total", "events_dropped_total",
         "firehose_published_total", "firehose_dropped_total",
         "slo_violations_total",
+        "tenancy_throttles_total", "tenancy_resumes_total",
+        "tenancy_quota_refusals_total", "tenancy_acl_denials_total",
     })
 
     @staticmethod
@@ -764,14 +830,22 @@ class AdminServer:
                 out.append(
                     f"chanamq_profile_stage_calls_total{labels} "
                     f"{int(prof.stage_calls[i])}")
+        registry = getattr(self.broker, "tenancy", None)
         out.append("# TYPE chanamq_queue_messages gauge")
         out.append("# TYPE chanamq_queue_ready_bytes gauge")
         out.append("# TYPE chanamq_queue_unacked gauge")
         out.append("# TYPE chanamq_queue_consumers gauge")
         for vhost in self.broker.vhosts.values():
             vl = self._prom_label(vhost.name)
+            # queue series on a tenant-owned vhost carry the tenant label;
+            # untenanted vhosts keep the exact two-label shape they had
+            owner = (registry.tenant_of_vhost(vhost.name)
+                     if registry is not None else None)
+            tl = (f',tenant="{self._prom_label(owner)}"'
+                  if owner is not None else "")
             for queue in vhost.queues.values():
-                labels = f'{{vhost="{vl}",queue="{self._prom_label(queue.name)}"}}'
+                labels = (f'{{vhost="{vl}",'
+                          f'queue="{self._prom_label(queue.name)}"{tl}}}')
                 out.append(
                     f"chanamq_queue_messages{labels} {queue.message_count}")
                 out.append(
@@ -828,18 +902,40 @@ class AdminServer:
             out.append("# TYPE chanamq_slo_burn_rate gauge")
             for spec in engine.specs:
                 status = engine.slo_status(spec)
+                tl = (f',tenant="{self._prom_label(spec.tenant)}"'
+                      if spec.tenant else "")
                 slabels = (f'{{slo="{self._prom_label(spec.name)}",'
-                           f'sli="{self._prom_label(spec.sli)}"}}')
+                           f'sli="{self._prom_label(spec.sli)}"{tl}}}')
                 out.append(
                     f"chanamq_slo_budget_remaining{slabels} "
                     f"{status['budget_remaining']}")
                 for pair in ("fast", "slow"):
                     blabels = (f'{{slo="{self._prom_label(spec.name)}",'
                                f'sli="{self._prom_label(spec.sli)}",'
-                               f'window="{pair}"}}')
+                               f'window="{pair}"{tl}}}')
                     out.append(
                         f"chanamq_slo_burn_rate{blabels} "
                         f"{status['burn'][f'{pair}_short']['burn_rate']}")
+        if registry is not None:
+            # per-tenant quota/traffic series: one row per tenant, labeled
+            # by tenant name (the noisy-neighbor dashboard's raw material)
+            out.append("# TYPE chanamq_tenancy_tenants gauge")
+            out.append(f"chanamq_tenancy_tenants {len(registry.tenants)}")
+            gauges = ("connections", "channels", "queues", "bindings",
+                      "resident_bytes", "tokens", "floor")
+            counters = ("published", "delivered", "refused", "throttles")
+            for field in gauges + ("gated",):
+                out.append(f"# TYPE chanamq_tenant_{field} gauge")
+            for field in counters:
+                out.append(f"# TYPE chanamq_tenant_{field} counter")
+            for name in sorted(registry.tenants):
+                snap = registry.tenants[name].snapshot()
+                labels = f'{{tenant="{self._prom_label(name)}"}}'
+                for field in gauges + counters:
+                    out.append(
+                        f"chanamq_tenant_{field}{labels} {snap[field]}")
+                out.append(
+                    f"chanamq_tenant_gated{labels} {int(snap['gated'])}")
         forecaster = getattr(self.broker, "forecaster", None)
         if forecaster is not None and forecaster.forecast is not None:
             # next-tick telemetry forecast (models/service.py): one gauge

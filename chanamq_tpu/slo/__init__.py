@@ -37,20 +37,21 @@ class SLISampler:
         self.broker = broker
         self.latency_threshold_ms = latency_threshold_ms
         self._prev: dict[str, float] = {}
-        self._prev_buckets: Optional[list[int]] = None
+        self._prev_buckets: dict[str, list[int]] = {}
 
     def _delta(self, name: str, value: float) -> float:
         prev = self._prev.get(name, value)
         self._prev[name] = value
         return max(0.0, value - prev)
 
-    def _latency_sample(self) -> tuple[float, float]:
-        """(good, bad) for the latency SLI: one sample per tick that saw
-        deliveries — good iff the tick's delta p99 is under threshold."""
-        hist = self.broker.metrics.publish_to_deliver_us
+    def _latency_sample(self, hist, key: str = "") -> tuple[float, float]:
+        """(good, bad) for a latency SLI: one sample per tick that saw
+        deliveries — good iff the tick's delta p99 is under threshold.
+        ``key`` separates the node-wide histogram's previous-bucket state
+        from each tenant's."""
         buckets = list(hist.buckets)
-        prev = self._prev_buckets
-        self._prev_buckets = buckets
+        prev = self._prev_buckets.get(key)
+        self._prev_buckets[key] = buckets
         if prev is None:
             return (0.0, 0.0)
         delta = [b - p for b, p in zip(buckets, prev)]
@@ -78,12 +79,33 @@ class SLISampler:
         delivered = self._delta("delivered", float(m.delivered_msgs))
         dead = self._delta("dead", float(m.dead_lettered_msgs))
         expired = self._delta("expired", float(m.expired_msgs))
-        return {
+        samples = {
             "publish-success": (published, refused + returned),
             "delivery-success": (delivered, dead + expired),
             "readiness": (1.0, 0.0) if ready else (0.0, 1.0),
-            "delivery-latency": self._latency_sample(),
+            "delivery-latency": self._latency_sample(
+                m.publish_to_deliver_us),
         }
+        registry = getattr(self.broker, "tenancy", None)
+        if registry is not None:
+            # tenant-scoped streams, keyed "<sli>@<tenant>" (the sample key
+            # a tenant-scoped SLOSpec reads). Publish bad-events are the
+            # tenant's quota/ACL refusals; the latency stream exists only
+            # for tenants whose delivery-latency SLO attached a histogram.
+            for name in sorted(registry.tenants):
+                tenant = registry.tenants[name]
+                samples[f"publish-success@{name}"] = (
+                    self._delta(f"published@{name}",
+                                float(tenant.published_total())),
+                    self._delta(f"refused@{name}", float(tenant.refused)))
+                samples[f"delivery-success@{name}"] = (
+                    self._delta(f"delivered@{name}",
+                                float(tenant.delivered_total())), 0.0)
+                samples[f"readiness@{name}"] = samples["readiness"]
+                if tenant.latency_hist is not None:
+                    samples[f"delivery-latency@{name}"] = (
+                        self._latency_sample(tenant.latency_hist, name))
+        return samples
 
 
 def engine_from_config(config, interval_s: float = 1.0) -> SLOEngine:
@@ -100,3 +122,17 @@ def engine_from_config(config, interval_s: float = 1.0) -> SLOEngine:
             slow_burn=float(config.get("chana.mq.slo.slow-burn") or 6.0),
         )
     return SLOEngine(specs)
+
+
+def attach_tenant_latency(engine: SLOEngine, registry) -> None:
+    """Allocate per-tenant publish->deliver histograms for every
+    delivery-latency spec that names a tenant (the delivery hot path only
+    observes into a tenant histogram that exists). Call after building or
+    replacing an engine while tenancy is enabled."""
+    if registry is None:
+        return
+    for spec in engine.specs:
+        if spec.tenant and spec.sli == "delivery-latency":
+            tenant = registry.tenants.get(spec.tenant)
+            if tenant is not None:
+                tenant.attach_latency()

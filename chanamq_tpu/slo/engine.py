@@ -67,6 +67,14 @@ class SLOSpec:
     slow_burn: float = 6.0
     budget_window: int = 259200
     severity: str = "critical"
+    #: tenant-scoped objective (chanamq_tpu/tenancy/): the spec evaluates
+    #: the tenant's OWN good/bad stream (sample key "<sli>@<tenant>") with
+    #: an independent error budget; None = node-wide stream, as before
+    tenant: Optional[str] = None
+
+    def sample_key(self) -> str:
+        """The key this spec reads from the per-tick samples dict."""
+        return self.sli if self.tenant is None else f"{self.sli}@{self.tenant}"
 
     def as_dict(self) -> dict:
         return {
@@ -76,6 +84,7 @@ class SLOSpec:
             "slow_windows": list(self.slow_windows),
             "fast_burn": self.fast_burn, "slow_burn": self.slow_burn,
             "budget_window": self.budget_window, "severity": self.severity,
+            "tenant": self.tenant,
         }
 
 
@@ -117,6 +126,10 @@ def specs_from_json(raw: list, interval_s: float = 1.0) -> list[SLOSpec]:
         sli = item.get("sli", "publish-success")
         if sli not in SLI_KINDS:
             raise ValueError(f"unknown sli {sli!r} (have {SLI_KINDS})")
+        tenant = item.get("tenant")
+        if tenant is not None and (not isinstance(tenant, str) or not tenant):
+            raise ValueError(
+                f"spec {item['name']!r}: tenant must be a non-empty string")
         kw = dict(
             name=str(item["name"]), sli=sli,
             objective=float(item.get("objective", 0.999)),
@@ -124,6 +137,7 @@ def specs_from_json(raw: list, interval_s: float = 1.0) -> list[SLOSpec]:
             fast_burn=float(item.get("fast_burn", 14.4)),
             slow_burn=float(item.get("slow_burn", 6.0)),
             severity=str(item.get("severity", "critical")),
+            tenant=tenant,
         )
         if "fast_windows_s" in item:
             kw["fast_windows"] = tuple(ticks(s) for s in item["fast_windows_s"])
@@ -244,7 +258,7 @@ class SLOEngine:
         events: list[dict] = []
         for spec in self.specs:
             track = self._tracks[spec.name]
-            good, bad = samples.get(spec.sli, (0.0, 0.0))
+            good, bad = samples.get(spec.sample_key(), (0.0, 0.0))
             track.push(tick, float(good), float(bad))
             for pair_name, windows, threshold in (
                 ("fast", spec.fast_windows, spec.fast_burn),
@@ -260,6 +274,7 @@ class SLOEngine:
                     info = {
                         "slo": spec.name, "pair": pair_name,
                         "sli": spec.sli, "severity": spec.severity,
+                        "tenant": spec.tenant,
                         "burn_short": round(b_short, 4),
                         "burn_long": round(b_long, 4),
                         "threshold": threshold,

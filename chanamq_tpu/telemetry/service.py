@@ -397,6 +397,7 @@ class TelemetryService:
             "queues": queues,
             "queue_keys": [[k[0], k[1]] for k in q_keys],
             "connections": connections,
+            "tenants": self.top_tenants(top or 0),
             "probes": self.node_probes(),
             "alerts": self.engine.snapshot(),
             "slo": self.slo.snapshot() if self.slo is not None else None,
@@ -445,6 +446,19 @@ class TelemetryService:
             out[2 * i] = latest[row, 3]      # depth
             out[2 * i + 1] = latest[row, 0]  # publish_rate
         return out
+
+    def top_tenants(self, k: int) -> list[dict]:
+        """Per-tenant rows for /admin/timeseries: live tenant snapshots
+        ordered by published+delivered traffic (top-K when k > 0, all
+        tenants otherwise). Empty when tenancy is off."""
+        registry = getattr(self.broker, "tenancy", None)
+        if registry is None:
+            return []
+        rows = [registry.tenants[name].snapshot()
+                for name in sorted(registry.tenants)]
+        rows.sort(key=lambda r: (-(r["published"] + r["delivered"]),
+                                 r["name"]))
+        return rows[:k] if k > 0 else rows
 
     def top_queues(self, k: int) -> list[dict]:
         """Top-k queues by publish+deliver rate with their latest vectors

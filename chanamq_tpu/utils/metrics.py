@@ -239,6 +239,15 @@ class Metrics:
         # all specs and window pairs (per-spec counts live in the engine
         # snapshot and the chanamq_slo_violations_total labeled series)
         self.slo_violations_total = 0
+        # multi-tenancy (chanamq_tpu/tenancy/): tenant gate transitions
+        # (token bucket drained / memory share breached, and the matching
+        # resumes), quota refusals at the declare/open mutation sites, and
+        # ACL denials mapped to access-refused. All zero unless
+        # chana.mq.tenant.enabled.
+        self.tenancy_throttles_total = 0
+        self.tenancy_resumes_total = 0
+        self.tenancy_quota_refusals_total = 0
+        self.tenancy_acl_denials_total = 0
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -400,6 +409,10 @@ class Metrics:
             "firehose_published_total": self.firehose_published_total,
             "firehose_dropped_total": self.firehose_dropped_total,
             "slo_violations_total": self.slo_violations_total,
+            "tenancy_throttles_total": self.tenancy_throttles_total,
+            "tenancy_resumes_total": self.tenancy_resumes_total,
+            "tenancy_quota_refusals_total": self.tenancy_quota_refusals_total,
+            "tenancy_acl_denials_total": self.tenancy_acl_denials_total,
         }
         for key, hist in self.trace_stage_us.items():
             base = key[:-3] if key.endswith("_us") else key
