@@ -1525,6 +1525,286 @@ async def _route_groups_spec(groups: int, records: int) -> dict:
         await srv.stop()
 
 
+# ---------------------------------------------------------------------------
+# --rpc: request-reply workload (exclusive reply queues, correlation ids)
+# ---------------------------------------------------------------------------
+
+async def _rpc_spec(clients: int = 4, servers: int = 2,
+                    paced_rate: int = 80) -> dict:
+    """Request-reply RPC: N clients each own an exclusive server-named
+    reply queue and publish correlated requests to a shared request
+    queue; M servers consume it and answer to ``reply_to`` with the
+    request's ``correlation_id``. Phase 1 is closed-loop (each client
+    pipelines nothing: one request in flight) for round-trips/s; phase 2
+    paces each client at a fixed request rate and reports the round-trip
+    p50/p99 — the small-message regime the RPCAcc workload targets."""
+    from chanamq_tpu.amqp.properties import BasicProperties
+    from chanamq_tpu.broker.server import BrokerServer
+    from chanamq_tpu.client import AMQPClient
+    from chanamq_tpu.store.memory import MemoryStore
+
+    closed_s = max(2.0, min(BENCH_SECONDS, 6.0))
+    paced_s = max(2.0, min(BENCH_SECONDS, 4.0))
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                       store=MemoryStore())
+    await srv.start()
+    conns: list = []
+    served = 0
+    try:
+        boot = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        conns.append(boot)
+        bch = await boot.channel()
+        await bch.queue_declare("rpc_q")
+
+        for _ in range(servers):
+            conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+            conns.append(conn)
+            ch = await conn.channel()
+            await ch.basic_qos(prefetch_count=64)
+
+            def on_req(msg, ch=ch):
+                nonlocal served
+                served += 1
+                ch.basic_publish(
+                    msg.body, routing_key=msg.properties.reply_to,
+                    properties=BasicProperties(
+                        correlation_id=msg.properties.correlation_id))
+                ch.basic_ack(msg.delivery_tag)
+
+            await ch.basic_consume("rpc_q", on_req)
+
+        class RpcClient:
+            def __init__(self):
+                self.waiting: dict = {}
+                self.seq = 0
+
+            async def open(self, idx: int):
+                self.idx = idx
+                self.conn = await AMQPClient.connect(
+                    "127.0.0.1", srv.bound_port)
+                conns.append(self.conn)
+                self.ch = await self.conn.channel()
+                ok = await self.ch.queue_declare("", exclusive=True)
+                self.reply_q = ok.queue
+
+                def on_reply(msg):
+                    fut = self.waiting.pop(
+                        msg.properties.correlation_id, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(None)
+
+                await self.ch.basic_consume(self.reply_q, on_reply,
+                                            no_ack=True)
+
+            async def call(self, body: bytes, timeout: float = 10.0):
+                self.seq += 1
+                cid = f"c{self.idx}-{self.seq}"
+                fut = asyncio.get_event_loop().create_future()
+                self.waiting[cid] = fut
+                self.ch.basic_publish(
+                    body, routing_key="rpc_q",
+                    properties=BasicProperties(
+                        reply_to=self.reply_q, correlation_id=cid))
+                await asyncio.wait_for(fut, timeout)
+
+        rpc_clients = []
+        for i in range(clients):
+            c = RpcClient()
+            await c.open(i)
+            rpc_clients.append(c)
+        body = b"r" * 64
+
+        # phase 1: closed loop
+        async def closed_loop(c) -> int:
+            n = 0
+            loop = asyncio.get_event_loop()
+            end = loop.time() + closed_s
+            while loop.time() < end:
+                await c.call(body)
+                n += 1
+            return n
+
+        t0 = time.perf_counter()
+        counts = await asyncio.gather(
+            *(closed_loop(c) for c in rpc_clients))
+        closed_wall = time.perf_counter() - t0
+        round_trips = sum(counts)
+
+        # phase 2: paced, round-trip latency under a fixed offered rate
+        async def paced_loop(c) -> list:
+            lats = []
+            loop = asyncio.get_event_loop()
+            interval = 1.0 / paced_rate
+            end = loop.time() + paced_s
+            nxt = loop.time()
+            while loop.time() < end:
+                nxt += interval
+                t = time.perf_counter()
+                await c.call(body)
+                lats.append((time.perf_counter() - t) * 1e6)
+                delay = nxt - loop.time()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+            return lats
+
+        lat_lists = await asyncio.gather(
+            *(paced_loop(c) for c in rpc_clients))
+        lats = sorted(x for lst in lat_lists for x in lst)
+
+        def pct(p: float):
+            return (round(lats[min(len(lats) - 1,
+                                   int(len(lats) * p))], 1)
+                    if lats else None)
+
+        return {
+            "clients": clients,
+            "servers": servers,
+            "round_trips": round_trips,
+            "round_trips_per_s": round(round_trips / closed_wall, 1),
+            "served": served,
+            "paced_rate_per_client": paced_rate,
+            "paced_samples": len(lats),
+            "paced_p50_us": pct(0.50),
+            "paced_p99_us": pct(0.99),
+        }
+    finally:
+        for conn in conns:
+            try:
+                await conn.close()
+            except Exception:
+                pass
+        await srv.stop()
+
+
+def run_rpc_spec() -> dict:
+    try:
+        return asyncio.run(asyncio.wait_for(_rpc_spec(), timeout=120))
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+# ---------------------------------------------------------------------------
+# --dlx: dead-letter + priority-queue scenario
+# ---------------------------------------------------------------------------
+
+async def _dlx_spec() -> dict:
+    """Delivery-semantics scenario: a burst into an x-max-priority queue
+    drained in strict priority order (the PriorityFan dispatch path at
+    bench scale), then a reject-everything pass through a dead-letter
+    exchange asserting exactly-once dead-lettering with x-death headers.
+    Reports burst drain throughput and the DLX round-trip rate."""
+    import random
+
+    from chanamq_tpu.amqp.properties import BasicProperties
+    from chanamq_tpu.broker.server import BrokerServer
+    from chanamq_tpu.client import AMQPClient
+    from chanamq_tpu.store.memory import MemoryStore
+
+    burst = int(3000 * max(1.0, min(BENCH_SECONDS / 5.0, 4.0)))
+    dlx_msgs = 500
+    rng = random.Random(17)
+    srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                       store=MemoryStore())
+    await srv.start()
+    conn = None
+    violations: list = []
+    try:
+        conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        ch = await conn.channel()
+        await ch.exchange_declare("bench_dlx", "fanout")
+        await ch.queue_declare("bench_dlq")
+        await ch.queue_bind("bench_dlq", "bench_dlx", "")
+        await ch.queue_declare("bench_prio", arguments={
+            "x-max-priority": 9,
+            "x-dead-letter-exchange": "bench_dlx"})
+
+        # phase 1: burst at shuffled priorities, drain in priority order
+        t0 = time.perf_counter()
+        for i in range(burst):
+            ch.basic_publish(
+                b"p" * 64, routing_key="bench_prio",
+                properties=BasicProperties(priority=rng.randrange(12)))
+        drained = 0
+        done = asyncio.get_event_loop().create_future()
+        last_prio = [9]
+
+        def on_prio(msg):
+            nonlocal drained
+            drained += 1
+            prio = min(msg.properties.priority or 0, 9)
+            if prio > last_prio[0]:
+                violations.append(
+                    f"priority inversion at {drained}: {prio} after "
+                    f"{last_prio[0]}")
+            last_prio[0] = prio
+            if drained >= burst and not done.done():
+                done.set_result(None)
+
+        tag = await ch.basic_consume("bench_prio", on_prio, no_ack=True)
+        await asyncio.wait_for(done, timeout=60)
+        await ch.basic_cancel(tag)
+        burst_wall = time.perf_counter() - t0
+
+        # phase 2: reject everything once -> exactly-once dead-lettering
+        t1 = time.perf_counter()
+        for i in range(dlx_msgs):
+            ch.basic_publish(b"d%d" % i, routing_key="bench_prio")
+        rejected = 0
+        rejected_done = asyncio.get_event_loop().create_future()
+
+        def on_reject(msg):
+            nonlocal rejected
+            rejected += 1
+            ch.basic_reject(msg.delivery_tag, requeue=False)
+            if rejected >= dlx_msgs and not rejected_done.done():
+                rejected_done.set_result(None)
+
+        tag = await ch.basic_consume("bench_prio", on_reject)
+        await asyncio.wait_for(rejected_done, timeout=60)
+        await ch.basic_cancel(tag)
+        seen: dict = {}
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while (len(seen) < dlx_msgs
+               and asyncio.get_event_loop().time() < deadline):
+            msg = await ch.basic_get("bench_dlq", no_ack=True)
+            if msg is None:
+                await asyncio.sleep(0.02)
+                continue
+            body = bytes(msg.body).decode()
+            seen[body] = seen.get(body, 0) + 1
+            deaths = (msg.properties.headers or {}).get("x-death") or []
+            if (len(deaths) != 1 or deaths[0].get("count") != 1
+                    or deaths[0].get("reason") != "rejected"):
+                violations.append(f"{body}: bad x-death {deaths}")
+        dlx_wall = time.perf_counter() - t1
+        if len(seen) != dlx_msgs:
+            violations.append(
+                f"dead-lettered {len(seen)}/{dlx_msgs} bodies")
+        if any(n != 1 for n in seen.values()):
+            violations.append("duplicate dead-letters")
+        return {
+            "burst": burst,
+            "burst_drain_per_s": round(burst / burst_wall, 1),
+            "dlx_msgs": dlx_msgs,
+            "dlx_round_trip_per_s": round(dlx_msgs / dlx_wall, 1),
+            "violations": violations,
+        }
+    finally:
+        if conn is not None:
+            try:
+                await conn.close()
+            except Exception:
+                pass
+        await srv.stop()
+
+
+def run_dlx_spec() -> dict:
+    try:
+        return asyncio.run(asyncio.wait_for(_dlx_spec(), timeout=180))
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def run_overhead(metric: str, variants: "list[tuple]",
                  budget_pct: "float | None" = None,
                  value_label: "str | None" = None,
@@ -1746,6 +2026,106 @@ def main() -> None:
             **({"error": {"stream_1p3c": result["error"]}}
                if "error" in result else {}),
         }))
+        return
+
+    if "--rpc" in sys.argv:
+        # request-reply workload: 4 clients x 2 servers over exclusive
+        # reply queues with correlation-id matching — closed-loop
+        # round-trips/s plus a paced round-trip p99
+        result = run_rpc_spec()
+        print(f"# rpc_4c2s: {result}", file=sys.stderr)
+        record = None
+        if "error" not in result:
+            record = trajectory_record("rpc_4c2s", {
+                "delivered_per_s": result.get("round_trips_per_s"),
+                "p50_us": result.get("paced_p50_us"),
+                "p99_us": result.get("paced_p99_us"),
+            })
+        if record is not None:
+            trajectory_append(record)
+        print(json.dumps({
+            "metric": "rpc_round_trips_per_s_4c2s",
+            "value": result.get("round_trips_per_s"),
+            "unit": "round-trips/s",
+            "vs_baseline": None,
+            "paced_p50_us": result.get("paced_p50_us"),
+            "paced_p99_us": result.get("paced_p99_us"),
+            "rpc_4c2s": result,
+            **({"error": {"rpc_4c2s": result["error"]}}
+               if "error" in result else {}),
+        }))
+        if "error" in result:
+            sys.exit(1)
+        return
+
+    if "--dlx" in sys.argv:
+        # delivery-semantics scenario: priority-fan burst drain in strict
+        # priority order, then reject-driven dead-lettering with
+        # exactly-once x-death assertions
+        result = run_dlx_spec()
+        print(f"# dlx_priority: {result}", file=sys.stderr)
+        record = None
+        if not result.get("error") and not result.get("violations"):
+            record = trajectory_record("dlx_priority", {
+                "delivered_per_s": result.get("burst_drain_per_s"),
+            })
+        if record is not None:
+            trajectory_append(record)
+        print(json.dumps({
+            "metric": "dlx_priority_burst_drain_per_s",
+            "value": result.get("burst_drain_per_s"),
+            "unit": "msgs/s",
+            "vs_baseline": None,
+            "dlx_round_trip_per_s": result.get("dlx_round_trip_per_s"),
+            "violations": result.get("violations"),
+            "dlx_priority": result,
+            **({"error": {"dlx_priority": result["error"]}}
+               if "error" in result else {}),
+        }))
+        if result.get("error") or result.get("violations"):
+            sys.exit(1)  # the tier-1 smoke must fail loudly
+        return
+
+    if "--semantics-soak" in sys.argv:
+        # delivery-semantics chaos soak: seeded kill -9 between Tx.Commit
+        # receipt and the WAL group commit (all-or-nothing recovery, no
+        # post-rollback ghosts) + TTL-expiry dead-lettering under seeded
+        # store faults (exactly-once); both run twice and must be
+        # byte-identical per seed
+        seed = 42
+        if "--seed" in sys.argv:
+            seed = int(sys.argv[sys.argv.index("--seed") + 1])
+        from chanamq_tpu.chaos.soak import run_semantics_soak
+
+        try:
+            result = asyncio.run(asyncio.wait_for(
+                run_semantics_soak(seed), timeout=240))
+        except Exception as exc:
+            result = {"seed": seed,
+                      "violations": [f"{type(exc).__name__}: {exc}"]}
+        print(f"# semantics_soak: {result}", file=sys.stderr)
+        print(json.dumps({
+            "metric": "semantics_soak_violations",
+            "value": len(result.get("violations", [])),
+            "unit": "violations",
+            "vs_baseline": None,
+            "seed": seed,
+            "deterministic": result.get("deterministic"),
+            "semantics_soak": {k: v for k, v in result.items()},
+        }))
+        if result.get("violations"):
+            sys.exit(1)  # the tier-1 smoke must fail loudly
+        return
+
+    if "--semantics-overhead" in sys.argv:
+        # master-switch cost: the standard transient scenario with the
+        # semantics subsystem disabled (no delay service, no cycle guard,
+        # plain deque ready lists) vs the default-on broker; the on-path
+        # may cost at most 2%
+        run_overhead(
+            "semantics_overhead_pct",
+            [("off", {"CHANAMQ_SEMANTICS_ENABLED": "false"}), ("on", None)],
+            budget_pct=-2.0)
         return
 
     if "--shard" in sys.argv:

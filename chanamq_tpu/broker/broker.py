@@ -32,6 +32,7 @@ from ..flow import (
     STAGE_REFUSE,
     STAGE_THROTTLE,
 )
+from ..semantics import DelayService, parse_delay, would_create_cycle
 from ..store.api import StoredExchange, StoredMessage, StoredQueue, StoreService
 from ..store.memory import MemoryStore
 from ..streams import VALID_QUEUE_TYPES, StreamQueue
@@ -90,6 +91,8 @@ class Broker:
         router_max_wildcards: int = 512,
         router_max_queues: int = 4096,
         router_verify: bool = False,
+        semantics_enabled: bool = True,
+        delay_tick_ms: int = 50,
     ) -> None:
         self.store = store or MemoryStore()
         self.idgen = IdGenerator(node_id)
@@ -112,6 +115,14 @@ class Broker:
         # set by chanamq_tpu.profile.enable_from_config when the cost
         # ledger is on (chana.mq.profile.enabled); admin serves its snapshot
         self.profile = None
+        # advanced delivery semantics (chanamq_tpu/semantics/): the master
+        # switch gates the per-publish x-delay probe and bind-time cycle
+        # refusal; self.delay is None when off, so the disabled publish
+        # path pays one attribute load
+        self.semantics_enabled = semantics_enabled
+        self.delay = (
+            DelayService(self, tick_ms=delay_tick_ms)
+            if semantics_enabled else None)
         # broker-wide entity gauges, maintained incrementally at every queue
         # mutation site (entities.py / streams/queue.py) so a sampler tick is
         # O(1) instead of a walk over every queue in every vhost
@@ -1140,6 +1151,22 @@ class Broker:
         if source == "" or destination == "":
             raise BrokerError(
                 ErrorCode.ACCESS_REFUSED, "cannot bind the default exchange")
+        if self.semantics_enabled and would_create_cycle(
+                vhost, source, destination):
+            # bind-time refusal (semantics/graph.py): the runtime walk is
+            # cycle-safe, but a cyclic graph blocks router closure
+            # flattening and is almost certainly a client bug — refuse at
+            # declare time like RabbitMQ does for argument conflicts
+            bus = events.ACTIVE
+            if bus is not None:
+                bus.emit("exchange.cycle_refused", {
+                    "vhost": vhost_name, "source": source,
+                    "destination": destination, "key": routing_key,
+                }, vhost_name=vhost_name)
+            raise BrokerError(
+                ErrorCode.PRECONDITION_FAILED,
+                f"binding exchange '{source}' to '{destination}' "
+                "would create a cycle")
         added = src.ensure_ex_matcher().bind(routing_key, destination, arguments)
         if added:
             # an e2e bind turns a cached single-hop route stale AND makes
@@ -1326,6 +1353,7 @@ class Broker:
                 # contains an explicit reject is a client-driven retry
                 # topology (work queue -> TTL retry queue -> work queue)
                 # and keeps flowing, per RabbitMQ's cycle rule.
+                self.metrics.dlx_cycle_drops += 1
                 self.unrefer(msg)
                 return
             entry["count"] = int(entry.get("count", 1)) + 1
@@ -1352,6 +1380,21 @@ class Broker:
         new_props.expiration = None
         routing_key = queue.dlx_rk if queue.dlx_rk is not None else msg.routing_key
         self.metrics.dead_lettered_msgs += 1
+        self.metrics.dlx_published += 1
+        if reason == "expired":
+            self.metrics.dlx_expired += 1
+        elif reason == "rejected":
+            self.metrics.dlx_rejected += 1
+        elif reason == "maxlen":
+            self.metrics.dlx_maxlen += 1
+        bus = events.ACTIVE
+        if bus is not None:
+            bus.emit("message.dead_lettered", {
+                "vhost": queue.vhost, "queue": queue.name,
+                "reason": reason, "exchange": queue.dlx,
+                "routing_key": routing_key,
+                "count": int(deaths[0].get("count", 1)),
+            }, vhost_name=queue.vhost)
         self.spawn(self._dead_letter_publish(
             queue.vhost, queue.dlx, routing_key, new_props, msg))
 
@@ -1421,6 +1464,16 @@ class Broker:
                 vhost_name, exchange_name, routing_key, properties, body,
                 mandatory=mandatory, immediate=immediate,
                 header_raw=header_raw, marks=marks, exrk_raw=exrk_raw)
+        delay = self.delay
+        if delay is not None and properties.headers is not None:
+            delay_ms = parse_delay(properties.headers)
+            if delay_ms is not None:
+                # x-delay: park in the timer wheel and re-route at fire
+                # time (mandatory/immediate are not honored for delayed
+                # publishes — delayed-message-exchange plugin parity)
+                delay.park(vhost_name, exchange_name, routing_key,
+                           properties, body, delay_ms)
+                return (True, True)
         tr = None
         t_route = 0
         if trace.ACTIVE is not None:
@@ -1457,6 +1510,13 @@ class Broker:
         per-message hot loop skips the coroutine machinery. Callers must
         check ``broker.cluster is None`` first."""
         assert self.cluster is None
+        delay = self.delay
+        if delay is not None and properties.headers is not None:
+            delay_ms = parse_delay(properties.headers)
+            if delay_ms is not None:
+                delay.park(vhost_name, exchange_name, routing_key,
+                           properties, body, delay_ms)
+                return (True, True)
         tr = None
         t_route = 0
         if trace.ACTIVE is not None:
@@ -1555,6 +1615,13 @@ class Broker:
         cluster_route_cached first."""
         local, remote = self._cluster_route_cache[
             (vhost_name, exchange_name, routing_key)]
+        delay = self.delay
+        if delay is not None and properties.headers is not None:
+            delay_ms = parse_delay(properties.headers)
+            if delay_ms is not None:
+                delay.park(vhost_name, exchange_name, routing_key,
+                           properties, body, delay_ms)
+                return (True, True)
         self.metrics.published(len(body))
         tr = None
         if trace.ACTIVE is not None:

@@ -2164,7 +2164,15 @@ class AMQPConnection:
             self.send_method(cid, am.Tx.CommitOk())
         elif isinstance(method, am.Tx.Rollback):
             self._require_tx(channel, method)
+            n_ops = len(channel.tx_ops)
             channel.tx_rollback()
+            self.broker.metrics.semantics_tx_rollbacks += 1
+            bus = events.ACTIVE
+            if bus is not None:
+                bus.emit("tx.rolledback", {
+                    "vhost": self.vhost_name, "channel": channel.id,
+                    "ops": n_ops,
+                }, vhost_name=self.vhost_name)
             self.send_method(cid, am.Tx.RollbackOk())
         else:
             raise HardError(
@@ -2184,13 +2192,32 @@ class AMQPConnection:
         CommitOk is only sent after (a) every clustered push the replay
         buffered has been accepted by its owner and (b) the store has
         committed every persistent write the replay enqueued — the same
-        promise a publisher confirm makes, per-op mark windows included."""
+        promise a publisher confirm makes, per-op mark windows included.
+
+        Single-node on a WalStore, the whole replay runs inside a WAL
+        transaction scope: every persistent write the commit enqueues is
+        sealed into ONE tx_batch record, so a SIGKILL between Tx.Commit
+        receipt and the WAL fsync replays all-or-nothing — a group-commit
+        batch of separate records can tear at record granularity and leave
+        a durable prefix of the transaction. The replay loop itself never
+        suspends on this path (publish() degenerates to publish_sync and
+        settles are plain calls), which is what keeps the scope atomic
+        with respect to the commit loop and checkpointer."""
         ops, channel.tx_ops = channel.tx_ops, []
         if channel.tx_bytes:
             self.broker.account_memory(-channel.tx_bytes)
             channel.tx_bytes = 0
+        prof = profile.ACTIVE
+        t_tx = time.perf_counter_ns() if prof is not None else 0
         store = self.broker.store
+        scoped = (self.broker.cluster is None
+                  and getattr(store, "tx_begin", None) is not None)
         marks: list[tuple[int, int]] = []
+        touched: list = []
+        mark0 = 0
+        if scoped:
+            mark0 = store.mark()
+            store.tx_begin()
         idx = 0
         try:
             while idx < len(ops):
@@ -2231,10 +2258,22 @@ class AMQPConnection:
                         channel.requeue(delivery)
                     else:
                         channel.drop(delivery)
-                    # the settle path never awaits, so this window covers
-                    # exactly the store deletes/updates this settle enqueued
-                    marks.append((before, store.mark()))
+                    if scoped:
+                        # the settle buffered its unack delete / watermark
+                        # for the next loop tick — pull it into the open
+                        # scope so staged acks commit atomically with the
+                        # staged publishes
+                        queue = delivery.queue
+                        if queue not in touched:
+                            touched.append(queue)
+                    else:
+                        # the settle path never awaits, so this window
+                        # covers exactly the deletes this settle enqueued
+                        marks.append((before, store.mark()))
                 idx += 1
+            if scoped:
+                for queue in touched:
+                    queue.flush_store_buffers()
         except BaseException:
             # partial-commit failure (e.g. a replayed publish hit a deleted
             # exchange): the error closes the channel, but ops not yet
@@ -2242,8 +2281,31 @@ class AMQPConnection:
             # the channel teardown requeues their deliveries. The failed op
             # itself is consumed (a raising publish routed nowhere; settles
             # never raise); later publishes drop, matching implicit-rollback
-            # semantics.
+            # semantics. An open WAL scope aborts whole: the client never
+            # got CommitOk, so nothing from this transaction may become
+            # durable (no partial replay on recovery). Settle bookkeeping
+            # still buffered on the queues is NOT pulled in — it flushes
+            # on the next loop tick, outside the aborted scope, so applied
+            # settles keep their durable records.
+            if scoped:
+                store.tx_abort()
             channel.tx_restore_settles(ops[idx + 1:])
             raise
+        if scoped:
+            lsn = store.tx_seal()
+            if lsn > mark0:
+                marks = [(mark0, lsn)]
+        if prof is not None:
+            # staged replay, scope open -> sealed; the awaited flush below
+            # is group-commit wall time and lands in WAL_COMMIT already
+            prof.stage_ns[profile.TX_COMMIT] += time.perf_counter_ns() - t_tx
+            prof.stage_calls[profile.TX_COMMIT] += 1
+        self.broker.metrics.semantics_tx_commits += 1
+        bus = events.ACTIVE
+        if bus is not None:
+            bus.emit("tx.committed", {
+                "vhost": self.vhost_name, "channel": channel.id,
+                "ops": len(ops), "atomic": scoped,
+            }, vhost_name=self.vhost_name)
         await self._settle_remote_failures()
         await store.flush(marks)

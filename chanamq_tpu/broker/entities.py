@@ -33,6 +33,7 @@ from typing import TYPE_CHECKING, Any, Callable, Optional
 
 from .. import profile, trace
 from ..amqp.properties import BasicProperties
+from ..semantics.priority import PriorityFan
 from ..store.api import StoredMessage
 from .matchers import Matcher, matcher_for
 
@@ -241,7 +242,13 @@ class Queue:
         # restart of THIS node would recover
         self.repl = None  # Optional[replicate.QueueRepLog]
 
-        self.messages: deque[QueuedMessage] = deque()
+        # ready list: plain FIFO deque, or — when x-max-priority is set —
+        # the per-priority fan (semantics/priority.py), which keeps the
+        # same (priority desc, offset) iteration order with O(1) enqueue
+        # and dispatch instead of ordered-insert scans
+        self.messages: Any = (
+            deque() if self.max_priority is None
+            else PriorityFan(self.max_priority))
         self.next_offset = 1
         self.last_consumed = 0
         self.consumers: list["Consumer"] = []
@@ -313,9 +320,12 @@ class Queue:
         if self.max_priority is None:
             self.messages.append(qm)
         else:
+            # ceiling clamp (RabbitMQ: priority above x-max-priority is
+            # treated as the maximum, not an error)
             qm.priority = min(message.properties.priority or 0,
                               self.max_priority)
-            self._insert_by_priority(qm)
+            self.messages.append(qm)  # fan routes by qm.priority
+            self.broker.metrics.semantics_priority_msgs += 1
         self.ready_bytes += qm.body_size
         self.n_published += 1
         if self._counted:
@@ -441,18 +451,7 @@ class Queue:
         delete of its queue-log row — if that delete has NOT flushed yet,
         cancel it (the row is still there) instead of re-inserting behind
         it, which would let the flush erase the re-inserted row."""
-        messages = self.messages
-        i = len(messages)
-        for idx, existing in enumerate(messages):
-            if (existing.priority < qm.priority
-                    or (existing.priority == qm.priority
-                        and existing.offset > qm.offset)):
-                i = idx
-                break
-        if i == len(messages):
-            messages.append(qm)
-        else:
-            messages.insert(i, qm)
+        self.messages.requeue(qm)  # offset-ordered within its band
         if self.durable and qm.message.persisted:
             try:
                 self._row_del_buf.remove(qm.offset)
@@ -475,20 +474,6 @@ class Queue:
                         "o": qm.offset, "m": qm.message.id,
                         "z": qm.body_size, "e": qm.expire_at_ms})
                 self.repl.append("unack_del", {"ids": [qm.message.id]})
-
-    def _insert_by_priority(self, qm: QueuedMessage) -> None:
-        """Ready-set ordering for priority queues: (priority desc, offset).
-        Scanned from the tail — same-or-lower priority than the tail (the
-        overwhelmingly common flat-priority flow) is a plain append."""
-        messages = self.messages
-        n = len(messages)
-        i = n
-        while i > 0 and messages[i - 1].priority < qm.priority:
-            i -= 1
-        if i == n:
-            messages.append(qm)
-        else:
-            messages.insert(i, qm)
 
     def _drop_overflow(self, watch: Optional[QueuedMessage] = None) -> bool:
         """Enforce x-max-length / x-max-length-bytes by dropping from the

@@ -277,6 +277,9 @@ class BrokerServer:
             router_max_queues=config.int("chana.mq.router.max-queues")
             or 4096,
             router_verify=config.bool("chana.mq.router.verify"),
+            semantics_enabled=config.bool("chana.mq.semantics.enabled"),
+            delay_tick_ms=max(1, round((config.duration_s(
+                "chana.mq.semantics.delay-tick") or 0.05) * 1000)),
         )
         if store is not None and hasattr(store, "metrics"):
             # the WAL engine's wal_* counters must land in the broker

@@ -2662,3 +2662,306 @@ async def run_tenant_churn(cycles: int = 10000, *,
             await srv.stop()
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------------------
+# delivery-semantics soak: Tx kill -9 at the WAL commit boundary +
+# TTL-expiry dead-lettering under seeded store faults
+# ---------------------------------------------------------------------------
+
+
+async def _tx_kill_run(seed: int) -> dict:
+    """One seeded transaction workload ending in a simulated SIGKILL
+    between Tx.Commit receipt and the WAL group commit.
+
+    A client runs a seeded mix of commits and rollbacks against a
+    WAL-backed broker; at the seeded kill index the store is "killed"
+    the instant the commit's tx_batch is sealed — before a single byte
+    of it can reach the segment file (the commit task is cancelled and
+    the write executors torn down synchronously, so the crash point is
+    a pure function of the seed). A fresh broker over the same directory
+    must then recover exactly the committed transactions: zero confirmed
+    loss, no post-rollback ghosts, and the killed transaction absent
+    as a whole (all-or-nothing)."""
+    import random
+    import shutil
+    import tempfile
+    from zlib import crc32
+
+    from ..amqp.properties import BasicProperties
+    from ..broker.server import BrokerServer
+    from ..client.client import AMQPClient
+    from ..store.sqlite import SqliteStore
+    from ..wal import WalStore
+
+    rng = random.Random((seed * 1_000_003) ^ crc32(b"tx-commit-kill"))
+    root = tempfile.mkdtemp(prefix="chanamq-semsoak-")
+    db = root + "/store.db"
+    log: list = []
+    violations: list[str] = []
+    committed: list[str] = []
+    rolled_back: list[str] = []
+    killed_bodies: list[str] = []
+    kill_at = 6 + rng.randrange(3)
+    try:
+        store = WalStore(SqliteStore(db), flush_ms=1.0,
+                         checkpoint_ms=3_600_000.0)
+        killed = asyncio.Event()
+        orig_seal = store.tx_seal
+        orig_flush = store.flush
+        armed = False
+
+        def seal_and_die():
+            # SIGKILL simulation, synchronous with the seal: nothing that
+            # happens after this line may reach disk
+            store._commit_task.cancel()
+            store._checkpoint_task.cancel()
+            store._inner._closed = True
+            store._executor.shutdown(wait=True)
+            store._inner._executor.shutdown(wait=False)
+            lsn = orig_seal()
+            killed.set()
+            return lsn
+
+        def flush(intervals=None):
+            if not killed.is_set():
+                return orig_flush(intervals)
+
+            async def _dead():
+                # the killed process writes nothing durable; completing
+                # the barrier (vs hanging) only lets the doomed coroutine
+                # unwind so teardown is clean — the disk state is already
+                # frozen by seal_and_die
+                return None
+            return _dead()
+
+        srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                           store=store)
+        await srv.start()
+        conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+        ch = await conn.channel()
+        await ch.queue_declare("txq", durable=True)
+        await ch.tx_select()
+        persistent = BasicProperties(delivery_mode=2)
+        commit_task = None
+        for i in range(12):
+            bodies = ["tx%d-%d" % (i, j)
+                      for j in range(1 + rng.randrange(3))]
+            roll = rng.random() < 0.3
+            if i == kill_at:
+                armed = True
+                store.tx_seal = seal_and_die
+                store.flush = flush
+            for body in bodies:
+                ch.basic_publish(body.encode(), routing_key="txq",
+                                 properties=persistent)
+            if i == kill_at:
+                killed_bodies = bodies
+                commit_task = asyncio.ensure_future(ch.tx_commit())
+                await asyncio.wait_for(killed.wait(), timeout=15)
+                log.append(["kill", i, len(bodies)])
+                break
+            if roll:
+                await ch.tx_rollback()
+                rolled_back.extend(bodies)
+                log.append(["rollback", i, len(bodies)])
+            else:
+                await ch.tx_commit()
+                committed.extend(bodies)
+                log.append(["commit", i, len(bodies)])
+        if not armed or not killed.is_set():
+            violations.append("kill rule never fired")
+        if commit_task is not None:
+            commit_task.cancel()
+        try:
+            await asyncio.wait_for(conn.close(), timeout=2)
+        except Exception:
+            pass
+        try:
+            await asyncio.wait_for(srv.stop(), timeout=3)
+        except Exception:
+            pass
+
+        # ---- recovery: a fresh broker over the same directory ----
+        store2 = WalStore(SqliteStore(db), flush_ms=1.0,
+                          checkpoint_ms=3_600_000.0)
+        srv2 = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                            store=store2)
+        await srv2.start()
+        try:
+            conn2 = await AMQPClient.connect("127.0.0.1", srv2.bound_port)
+            ch2 = await conn2.channel()
+            got: list[str] = []
+            deadline = asyncio.get_event_loop().time() + 5.0
+            while asyncio.get_event_loop().time() < deadline:
+                msg = await ch2.basic_get("txq", no_ack=True)
+                if msg is None:
+                    if len(got) >= len(committed):
+                        break
+                    await asyncio.sleep(0.02)
+                    continue
+                got.append(bytes(msg.body).decode())
+            missing = [b for b in committed if b not in got]
+            if missing:
+                violations.append(
+                    f"confirmed loss: {len(missing)} committed bodies "
+                    f"missing after recovery ({missing[:3]}...)")
+            ghosts = [b for b in got if b in rolled_back]
+            if ghosts:
+                violations.append(
+                    f"post-rollback ghosts recovered: {ghosts[:3]}")
+            kill_recovered = [b for b in killed_bodies if b in got]
+            if kill_recovered and len(kill_recovered) != len(killed_bodies):
+                violations.append(
+                    "killed tx partially recovered: "
+                    f"{len(kill_recovered)}/{len(killed_bodies)} — "
+                    "the tx_batch boundary is torn")
+            if got != committed + kill_recovered:
+                violations.append(
+                    f"recovered sequence diverges: got {len(got)} "
+                    f"expected {len(committed)}")
+            await conn2.close()
+            log.append(["recovered", len(got), len(kill_recovered)])
+        finally:
+            await srv2.stop()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "kill_at": kill_at,
+        "committed": len(committed),
+        "rolled_back": len(rolled_back),
+        "killed": len(killed_bodies),
+        "log": log,
+        "violations": violations,
+    }
+
+
+async def _ttl_dlx_run(seed: int) -> dict:
+    """TTL-expiry dead-lettering under a seeded degraded-storage window
+    (the single-node stand-in for a partition: flushes dropped, writes
+    delayed — the durability path is unreachable, the broker keeps
+    running). Every expired body must arrive in the DLQ exactly once
+    with exactly one x-death entry."""
+    import random
+    from zlib import crc32
+
+    from ..amqp.properties import BasicProperties
+    from ..broker.broker import Broker
+    from ..broker.server import BrokerServer
+    from ..client.client import AMQPClient
+    from ..store.memory import MemoryStore
+    from .store import ChaosStore
+
+    rng = random.Random((seed * 1_000_003) ^ crc32(b"ttl-dlx-partition"))
+    messages = 40
+    plan = FaultPlan(seed, [
+        FaultRule(name="dlx-partition-flush", kind="drop",
+                  sites=["store.flush"], after=2, count=4),
+        FaultRule(name="dlx-partition-latency", kind="latency",
+                  sites=["store.write", "store.delete"],
+                  probability=0.25, delay_ms=2),
+    ])
+    install(plan)
+    violations: list[str] = []
+    try:
+        broker = Broker(message_sweep_interval_s=0.05,
+                        store=ChaosStore(MemoryStore(), _LazyRuntime()))
+        srv = BrokerServer(broker=broker, host="127.0.0.1", port=0,
+                           heartbeat_s=0)
+        await srv.start()
+        try:
+            conn = await AMQPClient.connect("127.0.0.1", srv.bound_port)
+            ch = await conn.channel()
+            await ch.exchange_declare("soak_dlx", "fanout", durable=True)
+            await ch.queue_declare("soak_dlq", durable=True)
+            await ch.queue_bind("soak_dlq", "soak_dlx", "")
+            # durable queue + persistent bodies so expiry/dead-letter
+            # bookkeeping actually crosses the (faulted) store sites
+            await ch.queue_declare("soak_ttl", durable=True, arguments={
+                "x-message-ttl": 60,
+                "x-dead-letter-exchange": "soak_dlx",
+                "x-dead-letter-routing-key": "dead"})
+            for i in range(messages):
+                props = BasicProperties(delivery_mode=2)
+                if rng.random() < 0.4:  # per-message TTL below queue TTL
+                    props = BasicProperties(delivery_mode=2, expiration="30")
+                ch.basic_publish(b"dl%d" % i, routing_key="soak_ttl",
+                                 properties=props)
+            counts: dict = {}
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while (sum(counts.values()) < messages
+                   and asyncio.get_event_loop().time() < deadline):
+                msg = await ch.basic_get("soak_dlq", no_ack=True)
+                if msg is None:
+                    await asyncio.sleep(0.02)
+                    continue
+                body = bytes(msg.body).decode()
+                counts[body] = counts.get(body, 0) + 1
+                deaths = (msg.properties.headers or {}).get("x-death") or []
+                if len(deaths) != 1 or deaths[0].get("count") != 1:
+                    violations.append(
+                        f"{body}: x-death not exactly-once: {deaths}")
+                elif deaths[0].get("reason") != "expired":
+                    violations.append(
+                        f"{body}: wrong death reason {deaths[0]}")
+            expected = {"dl%d" % i for i in range(messages)}
+            missing = sorted(expected - set(counts))
+            dupes = sorted(b for b, n in counts.items() if n > 1)
+            if missing:
+                violations.append(
+                    f"{len(missing)} expired bodies never dead-lettered "
+                    f"({missing[:3]}...)")
+            if dupes:
+                violations.append(f"duplicate dead-letters: {dupes[:3]}")
+            if broker.metrics.dlx_expired != messages:
+                violations.append(
+                    f"dlx_expired={broker.metrics.dlx_expired}, "
+                    f"expected {messages}")
+            dead_lettered = sum(counts.values())
+            await conn.close()
+        finally:
+            await srv.stop()
+    finally:
+        clear()
+    return {
+        "messages": messages,
+        "dead_lettered": dead_lettered,
+        "fires": plan.total_fires,
+        "violations": violations,
+    }
+
+
+async def run_semantics_soak(seed: int) -> dict:
+    """Delivery-semantics chaos soak (ISSUE 17): both seeded rules run
+    TWICE with the same seed and their normalized reports must serialize
+    byte-identically — the fault schedule, the tx mix, the kill index and
+    the recovery outcome are all pure functions of the seed."""
+    import json as _json
+
+    tx1 = await _tx_kill_run(seed)
+    tx2 = await _tx_kill_run(seed)
+    dlx1 = await _ttl_dlx_run(seed)
+    dlx2 = await _ttl_dlx_run(seed)
+
+    violations: list[str] = []
+    for tag, run in (("tx", tx1), ("tx-repeat", tx2),
+                     ("ttl-dlx", dlx1), ("ttl-dlx-repeat", dlx2)):
+        violations.extend(f"{tag}: {v}" for v in run["violations"])
+
+    def normalize(run: dict) -> str:
+        return _json.dumps(
+            {k: v for k, v in run.items() if k != "violations"},
+            sort_keys=True)
+
+    if normalize(tx1) != normalize(tx2):
+        violations.append("same-seed tx-kill runs are not byte-identical")
+    if normalize(dlx1) != normalize(dlx2):
+        violations.append("same-seed ttl-dlx runs are not byte-identical")
+    return {
+        "seed": seed,
+        "tx": tx1,
+        "ttl_dlx": dlx1,
+        "deterministic": normalize(tx1) == normalize(tx2)
+        and normalize(dlx1) == normalize(dlx2),
+        "violations": violations,
+    }

@@ -334,6 +334,15 @@ DEFAULTS: dict[str, Any] = {
     # the oracle on mismatch (router_parity_mismatches counts them) —
     # a debugging net, not for production throughput
     "chana.mq.router.verify": False,
+    # advanced delivery semantics (chanamq_tpu/semantics/): atomic Tx
+    # commits on the WAL scope, bind-time e2e cycle refusal, and x-delay
+    # delayed delivery. Off removes the per-publish x-delay probe and the
+    # cycle check; queue-argument features (x-max-priority ordering,
+    # dead-lettering) are declared per queue and stay on either way.
+    "chana.mq.semantics.enabled": True,
+    # timer-wheel granularity for x-delay delayed delivery: fires land
+    # within one tick after their delay elapses
+    "chana.mq.semantics.delay-tick": "50ms",
     # continuous profiling (chanamq_tpu/profile/): disabled by default —
     # every hot-path seam stays a module-level `ACTIVE is None` check.
     # Enabled, the per-message cost ledger accumulates per-stage CPU-ns

@@ -31,7 +31,7 @@ from typing import Optional
 from .runtime import (  # noqa: F401 — re-exported page for the seams
     CLUSTER_PUSH, DELIVER, DISPATCH, ENQUEUE, FLOW_THROTTLE, GC,
     INGRESS_CYCLE, INGRESS_PARSE, ROUTE, SETTLE, STAGES, SUBSYSTEMS,
-    TOP_LEVEL, WAL_APPEND, WAL_COMMIT, ProfileRuntime,
+    TOP_LEVEL, TX_COMMIT, WAL_APPEND, WAL_COMMIT, ProfileRuntime,
 )
 
 # The gate. Hot-path seams do `prof = profile.ACTIVE` then

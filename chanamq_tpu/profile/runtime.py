@@ -43,13 +43,16 @@ STAGES = (
     "dispatch",        # 9  whole coalesced dispatch pass (top-level)
     "ingress-cycle",   # 10 whole read-chunk consume cycle (top-level)
     "gc",              # 11 collector pauses (gc.callbacks)
+    "tx-commit",       # 12 Tx.Commit staged replay: scope open -> sealed
 )
 (INGRESS_PARSE, ROUTE, ENQUEUE, WAL_APPEND, WAL_COMMIT, CLUSTER_PUSH,
- DELIVER, SETTLE, FLOW_THROTTLE, DISPATCH, INGRESS_CYCLE, GC) = range(12)
+ DELIVER, SETTLE, FLOW_THROTTLE, DISPATCH, INGRESS_CYCLE, GC,
+ TX_COMMIT) = range(13)
 
 SUBSYSTEMS = (
     "broker", "router", "broker", "wal", "wal", "cluster",
     "broker", "broker", "flow", "broker", "broker", "runtime",
+    "broker",
 )
 
 # stages whose windows tile the event loop without overlapping: their sum

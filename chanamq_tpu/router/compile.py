@@ -175,6 +175,14 @@ def _compile_topic(ce, bindings, max_wildcards: int, max_queues: int) -> None:
             wild.setdefault(key, set()).add(queue)
     ce.exact = {k: frozenset(v) for k, v in exact.items()}
     ce.always = frozenset(always)
+    _build_wild_table(ce, wild, max_wildcards, max_queues)
+
+
+def _build_wild_table(ce, wild: dict, max_wildcards: int,
+                      max_queues: int) -> None:
+    """Tokenize wildcard topic patterns (pattern -> queue-name set) into
+    the kernel matrices. Shared by the single-exchange topic compile and
+    the e2e closure compile (compile_effective)."""
     if not wild:
         return
     if len(wild) > max_wildcards:
@@ -225,6 +233,57 @@ def _compile_topic(ce, bindings, max_wildcards: int, max_queues: int) -> None:
     ce.wild = {"n": len(rows), "vocab": vocab, "p": p, "s": s,
                "pre": pre_t, "suf": suf_t, "plen": plen, "slen": slen,
                "has_hash": has_h, "masks": masks, "mask_words": mask_words}
+
+
+def compile_effective(
+    exact: dict,
+    always: Iterable[str],
+    wild: dict,
+    *,
+    generation: int = 0,
+    max_wildcards: int = 512,
+    max_queues: int = 4096,
+) -> CompiledExchange:
+    """Compile a FLATTENED e2e closure (TensorRouter._closure_bindings):
+    ``exact`` maps routing keys (string equality — covers direct bindings
+    and wildcard-free topic patterns) to queue-name sets, ``always`` is
+    the unconditional set (fanout members, lone-'#' patterns), ``wild``
+    maps genuine topic wildcard patterns to queue-name sets. Compiled as
+    kind "topic" because the topic evaluation path (exact dict + always +
+    wildcard kernel) is the universal shape the closure folds into."""
+    ce = CompiledExchange("topic", generation)
+    ce.exact = {k: frozenset(v) for k, v in exact.items()}
+    ce.always = frozenset(always)
+    _build_wild_table(ce, dict(wild), max_wildcards, max_queues)
+    return ce
+
+
+def topic_match(pattern: str, key: str) -> bool:
+    """One AMQP topic pattern against one concrete key, as a pure
+    function ('*' = exactly one word, '#' = zero or more). Used at
+    closure-compile time to evaluate hop-predicate conjunctions against
+    known keys — never on the publish path."""
+    pt = pattern.split(".")
+    kt = key.split(".")
+    memo: dict[tuple[int, int], bool] = {}
+
+    def m(i: int, j: int) -> bool:
+        got = memo.get((i, j))
+        if got is not None:
+            return got
+        if i == len(pt):
+            out = j == len(kt)
+        elif pt[i] == "#":
+            # zero words, or absorb one and stay on the '#'
+            out = m(i + 1, j) or (j < len(kt) and m(i, j + 1))
+        elif j == len(kt):
+            out = False
+        else:
+            out = (pt[i] == "*" or pt[i] == kt[j]) and m(i + 1, j + 1)
+        memo[(i, j)] = out
+        return out
+
+    return m(0, 0)
 
 
 def _topic_kernel(xp, pre_t, suf_t, plen, slen, has_h, masks,

@@ -47,6 +47,22 @@ _DEFERRABLE_TYPES = ("direct", "fanout", "topic", "headers")
 _QUEUE_CACHE_CAP = 8192
 
 
+def _classify_topic(pattern: str, queues, exact: dict, always: set,
+                    wild: dict) -> None:
+    """Sort one topic pattern into the universal closure shape: exact
+    string key, unconditional, or genuine wildcard row."""
+    toks = pattern.split(".")
+    nhash = toks.count("#")
+    if nhash == 0 and "*" not in toks:
+        exact.setdefault(pattern, set()).update(queues)
+    elif toks == ["#"]:
+        always.update(queues)
+    elif nhash > 1:
+        raise rcompile.Uncompilable("multi-# pattern")
+    else:
+        wild.setdefault(pattern, set()).update(queues)
+
+
 class TensorRouter:
     """Per-broker batch router over compiled binding tables."""
 
@@ -73,6 +89,11 @@ class TensorRouter:
         self._defer: dict = {}
         # (vhost, frozenset-of-names) -> [Queue]
         self._queue_cache: dict = {}
+        # closure dependency edges: (vhost, member-exchange) -> set of
+        # (vhost, root-exchange) whose flattened snapshot embeds the
+        # member's bindings — a bind/unbind anywhere in a compiled e2e
+        # graph must drop every root built over it
+        self._closure_deps: dict = {}
 
     # -- invalidation ------------------------------------------------------
 
@@ -87,8 +108,15 @@ class TensorRouter:
         self._queue_cache.clear()
         if vhost is None or exchange is None:
             self._compiled.clear()
+            self._closure_deps.clear()
         else:
             self._compiled.pop((vhost, exchange), None)
+            # dependent invalidation: every flattened e2e root whose
+            # closure walked through this exchange recompiles lazily too
+            roots = self._closure_deps.pop((vhost, exchange), None)
+            if roots:
+                for root_key in roots:
+                    self._compiled.pop(root_key, None)
 
     # -- deferral decision (publish hot path) ------------------------------
 
@@ -115,9 +143,18 @@ class TensorRouter:
         exchange = vhost.exchanges.get(exchange_name)
         if exchange is None or exchange.internal:
             return False
-        if exchange.ex_matcher is not None or exchange.alternate is not None:
+        if exchange.alternate is not None:
             return False
-        return exchange.type in _DEFERRABLE_TYPES
+        if exchange.type not in _DEFERRABLE_TYPES:
+            return False
+        if exchange.ex_matcher is not None:
+            # e2e source: defer only when the graph closure flattened into
+            # a compiled snapshot (semantics PR) — an uncompilable closure
+            # keeps the inline per-message walk, since a batched fallback
+            # would just re-run the same walk later
+            return self._get_compiled(
+                vhost, vhost_name, exchange_name) is not None
+        return True
 
     # -- batch routing -----------------------------------------------------
 
@@ -130,11 +167,15 @@ class TensorRouter:
             metrics = self.broker.metrics
             metrics.router_generation = self.generation
             try:
-                comp = rcompile.compile_exchange(
-                    exchange.type, exchange.matcher.bindings(),
-                    generation=self.generation,
-                    max_wildcards=self.max_wildcards,
-                    max_queues=self.max_queues)
+                if exchange.ex_matcher is not None:
+                    comp = self._compile_closure(
+                        vhost, vhost_name, exchange_name)
+                else:
+                    comp = rcompile.compile_exchange(
+                        exchange.type, exchange.matcher.bindings(),
+                        generation=self.generation,
+                        max_wildcards=self.max_wildcards,
+                        max_queues=self.max_queues)
                 metrics.router_compiles += 1
             except rcompile.Uncompilable as exc:
                 comp = exc.reason
@@ -142,6 +183,96 @@ class TensorRouter:
                           vhost_name, exchange_name, exc.reason)
             self._compiled[key] = comp
         return None if isinstance(comp, str) else comp
+
+    # -- e2e closure flattening --------------------------------------------
+
+    def _compile_closure(self, vhost, vhost_name: str, root: str):
+        """Flatten `root`'s exchange-to-exchange graph closure into one
+        compiled table: a publish routed through the snapshot reaches the
+        exact queue set the runtime breadth-first walk would, with zero
+        per-message graph traversal. Each hop's predicate composes by
+        CONJUNCTION (every hop re-matches the ORIGINAL routing key), so
+        only trivially-chainable graphs flatten — always-match edges
+        (fanout, lone '#') merge the sub-closure wholesale, exact-key
+        edges evaluate it at the known key, and a genuine-wildcard edge
+        composes with exact/always sub-entries only. Anything else
+        (wildcard-over-wildcard, headers, alternate-exchange fallbacks,
+        recovered cycles) raises Uncompilable and stays on the walk."""
+        exact: dict[str, set] = {}
+        always: set = set()
+        wild: dict[str, set] = {}
+        self._flatten(vhost, vhost_name, root, root,
+                      exact, always, wild, (root,))
+        return rcompile.compile_effective(
+            exact, always, wild, generation=self.generation,
+            max_wildcards=self.max_wildcards, max_queues=self.max_queues)
+
+    def _flatten(self, vhost, vhost_name: str, root: str, name: str,
+                 exact: dict, always: set, wild: dict, path: tuple) -> None:
+        # dependency edge FIRST (even for dangling/failing members): an
+        # Uncompilable verdict cached for the root must also be dropped
+        # when any member's bindings change
+        self._closure_deps.setdefault((vhost_name, name), set()).add(
+            (vhost_name, root))
+        ex = vhost.exchanges.get(name)
+        if ex is None:
+            return  # dangling e2e target: routes nowhere until redeclared
+        if ex.alternate is not None:
+            raise rcompile.Uncompilable("alternate exchange in e2e closure")
+        kind = ex.type
+        if kind == "headers":
+            raise rcompile.Uncompilable("headers exchange in e2e closure")
+        if kind not in ("direct", "fanout", "topic"):
+            raise rcompile.Uncompilable(f"e2e closure over {kind!r}")
+        for key, queue, _args in ex.matcher.bindings():
+            if kind == "fanout":
+                always.add(queue)
+            elif kind == "direct":
+                exact.setdefault(key, set()).add(queue)
+            else:
+                _classify_topic(key, (queue,), exact, always, wild)
+        if ex.ex_matcher is None:
+            return
+        for pkey, dst, _args in ex.ex_matcher.bindings():
+            if dst in path:
+                # a pre-guard (recovered) cycle: the walk dedups it, a
+                # flat table cannot represent it
+                raise rcompile.Uncompilable("cycle in e2e closure")
+            s_exact: dict[str, set] = {}
+            s_always: set = set()
+            s_wild: dict[str, set] = {}
+            self._flatten(vhost, vhost_name, root, dst,
+                          s_exact, s_always, s_wild, path + (dst,))
+            toks = pkey.split(".") if kind == "topic" else None
+            if kind == "fanout" or (toks is not None and toks == ["#"]):
+                # always-match hop: sub-closure merges wholesale
+                always.update(s_always)
+                for k, qs in s_exact.items():
+                    exact.setdefault(k, set()).update(qs)
+                for pat, qs in s_wild.items():
+                    wild.setdefault(pat, set()).update(qs)
+            elif kind == "direct" or ("#" not in toks and "*" not in toks):
+                # exact-key hop: evaluate the sub-closure at the one key
+                # that can traverse it (compile-time, never per-message)
+                qs = set(s_exact.get(pkey, ())) | s_always
+                for pat, sq in s_wild.items():
+                    if rcompile.topic_match(pat, pkey):
+                        qs |= sq
+                if qs:
+                    exact.setdefault(pkey, set()).update(qs)
+            else:
+                # genuine wildcard hop: p AND sub-predicate composes only
+                # when the sub side is trivial (TRUE or an exact key)
+                if toks.count("#") > 1:
+                    raise rcompile.Uncompilable("multi-# e2e pattern")
+                if s_always:
+                    _classify_topic(pkey, s_always, exact, always, wild)
+                for k, qs in s_exact.items():
+                    if rcompile.topic_match(pkey, k):
+                        exact.setdefault(k, set()).update(qs)
+                if s_wild:
+                    raise rcompile.Uncompilable(
+                        "wildcard-over-wildcard e2e chain")
 
     def _queues(self, vhost_name: str, vhost, names) -> list:
         """Resolve a routed name-set to live Queue objects, memoized per
@@ -175,22 +306,39 @@ class TensorRouter:
             use_kernel = compiled is not None and (
                 compiled.kernel_rows == 0 or len(idxs) >= self.min_batch)
             if not use_kernel:
-                # Python matcher fallback: uncompilable table, or a batch
-                # too small to amortize the kernel dispatch
+                # Python fallback: uncompilable table, or a batch too
+                # small to amortize the kernel dispatch. An e2e source
+                # falls back to the full graph walk, not the single-hop
+                # matcher — the closure IS the exchange's route set.
                 metrics.router_fallback_msgs += len(idxs)
-                matcher = vhost.exchanges[exchange_name].matcher
-                for idx in idxs:
-                    entry = entries[idx]
-                    names = frozenset(
-                        matcher.route(entry[1], entry[2].headers))
-                    out[idx] = self._queues(vhost_name, vhost, names)
+                exchange = vhost.exchanges[exchange_name]
+                if exchange.ex_matcher is not None:
+                    for idx in idxs:
+                        entry = entries[idx]
+                        names = frozenset(vhost.route(
+                            exchange_name, entry[1], entry[2].headers))
+                        out[idx] = self._queues(vhost_name, vhost, names)
+                else:
+                    matcher = exchange.matcher
+                    for idx in idxs:
+                        entry = entries[idx]
+                        names = frozenset(
+                            matcher.route(entry[1], entry[2].headers))
+                        out[idx] = self._queues(vhost_name, vhost, names)
                 continue
             items = [(entries[i][1], entries[i][2].headers) for i in idxs]
             name_sets = rcompile.route_batch(compiled, items, self.backend)
             if self.verify:
-                matcher = vhost.exchanges[exchange_name].matcher
+                exchange = vhost.exchanges[exchange_name]
+                if exchange.ex_matcher is not None:
+                    # live oracle for a flattened closure is the runtime
+                    # graph walk itself
+                    def _oracle(k, h, _n=exchange_name):
+                        return vhost.route(_n, k, h)
+                else:
+                    _oracle = exchange.matcher.route
                 for pos, (key, headers) in enumerate(items):
-                    oracle = matcher.route(key, headers)
+                    oracle = _oracle(key, headers)
                     if set(name_sets[pos]) != oracle:
                         metrics.router_parity_mismatches += 1
                         log.error(

@@ -155,6 +155,8 @@ class Metrics:
         self.wal_memtable_drains = 0
         self.wal_memtable_elided = 0
         self.wal_memtable_hits = 0
+        self.wal_tx_batches = 0
+        self.wal_tx_batch_ops = 0
         self.wal_commit_us = Histogram()
         # multi-process sharding (chanamq_tpu/shard/): cross-shard UDS
         # pushes, ownership re-hashes observed on sibling death, and the
@@ -248,6 +250,20 @@ class Metrics:
         self.tenancy_resumes_total = 0
         self.tenancy_quota_refusals_total = 0
         self.tenancy_acl_denials_total = 0
+        # delivery semantics (chanamq_tpu/semantics/): Tx commits/rollbacks
+        # on the WAL scope, delayed-delivery timer-wheel traffic, priority
+        # fan enqueues, and dead-letter outcomes (cycle suppressions are
+        # fully-automatic x-death loops dropped per the RabbitMQ rule).
+        self.semantics_tx_commits = 0
+        self.semantics_tx_rollbacks = 0
+        self.semantics_delayed_msgs = 0
+        self.semantics_delay_fired = 0
+        self.semantics_priority_msgs = 0
+        self.dlx_published = 0
+        self.dlx_cycle_drops = 0
+        self.dlx_expired = 0
+        self.dlx_rejected = 0
+        self.dlx_maxlen = 0
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -378,6 +394,8 @@ class Metrics:
             "wal_memtable_drains": self.wal_memtable_drains,
             "wal_memtable_elided": self.wal_memtable_elided,
             "wal_memtable_hits": self.wal_memtable_hits,
+            "wal_tx_batches": self.wal_tx_batches,
+            "wal_tx_batch_ops": self.wal_tx_batch_ops,
             "wal_commit_p50_us": self.wal_commit_us.percentile_us(0.50),
             "wal_commit_p99_us": self.wal_commit_us.percentile_us(0.99),
             "wal_commit_mean_us": self.wal_commit_us.mean_us,
@@ -413,6 +431,16 @@ class Metrics:
             "tenancy_resumes_total": self.tenancy_resumes_total,
             "tenancy_quota_refusals_total": self.tenancy_quota_refusals_total,
             "tenancy_acl_denials_total": self.tenancy_acl_denials_total,
+            "semantics_tx_commits": self.semantics_tx_commits,
+            "semantics_tx_rollbacks": self.semantics_tx_rollbacks,
+            "semantics_delayed_msgs": self.semantics_delayed_msgs,
+            "semantics_delay_fired": self.semantics_delay_fired,
+            "semantics_priority_msgs": self.semantics_priority_msgs,
+            "dlx_published": self.dlx_published,
+            "dlx_cycle_drops": self.dlx_cycle_drops,
+            "dlx_expired": self.dlx_expired,
+            "dlx_rejected": self.dlx_rejected,
+            "dlx_maxlen": self.dlx_maxlen,
         }
         for key, hist in self.trace_stage_us.items():
             base = key[:-3] if key.endswith("_us") else key

@@ -65,6 +65,13 @@ OPS = (
     # the hot path frames (and CRCs) once per publish instead of twice.
     # Appended after the fact: wire indices above never move.
     "insert_published",
+    # atomic transaction scope: ([(op_index, args), ...],) — every store
+    # mutation a Tx.Commit staged, framed as ONE record with ONE CRC.
+    # scan_frames cannot split inside a frame, so a crash either keeps the
+    # whole transaction (record durable) or loses it whole (torn tail
+    # truncated): the all-or-nothing guarantee multi-record commits cannot
+    # give, because a group-commit batch can tear at record granularity.
+    "tx_batch",
 )
 OP_INDEX = {name: i for i, name in enumerate(OPS)}
 

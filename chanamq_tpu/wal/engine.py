@@ -351,6 +351,10 @@ class WalStore(StoreService):
         # it into one insert_published record; every other observation
         # point flushes it first (see _flush_stash)
         self._stash = None
+        # open transaction scope: while not None, _ingest diverts every
+        # (op, args) here instead of framing it, and tx_seal() folds the
+        # lot into ONE tx_batch record (see tx_begin)
+        self._tx_buf: Optional[list] = None
         # stream maintenance bookkeeping
         self._compact_flag: dict[tuple[str, str], bool] = {}
         self._compacted_thru: dict[tuple[str, str], int] = {}
@@ -418,6 +422,14 @@ class WalStore(StoreService):
     def _ingest(self, lsn: int, op: str, args: tuple, frame: bytes) -> None:
         """Shared append bookkeeping once a frame's bytes exist: stage for
         the commit loop, stage for the memtable drain, count, wake."""
+        if self._tx_buf is not None:
+            # open transaction scope: the op joins the scope buffer and its
+            # individually framed bytes are discarded — tx_seal() re-frames
+            # the whole scope as one atomic tx_batch record. The memtable is
+            # NOT staged here either, so an aborted scope leaves no trace
+            # (the scope is synchronous: no read can interleave mid-scope).
+            self._tx_buf.append((op, args))
+            return
         self._lsn = lsn
         self._buf.append(frame)
         n = len(frame)
@@ -906,6 +918,67 @@ class WalStore(StoreService):
         lsn = self._append(name, args)
         return self._barrier(lsn, [(lsn - 1, lsn)])
 
+    # -- transaction scope (Tx.Commit atomicity) ----------------------------
+    #
+    # A group-commit batch is one fsync but MANY frames: scan_frames
+    # truncates at the first torn frame, so a SIGKILL mid-write can leave a
+    # durable prefix of a multi-record transaction — partial commit on
+    # replay.  The scope closes that hole: between tx_begin() and tx_seal()
+    # every append diverts into a buffer and the seal frames the lot as one
+    # tx_batch record (one CRC — fully durable or fully torn).  The scope
+    # MUST stay synchronous (no awaits between begin and seal): reads,
+    # drains, checkpoints and the commit loop all assume they never observe
+    # a half-open scope, which a single event-loop turn guarantees.
+
+    def tx_begin(self) -> None:
+        """Open an atomic append scope. Raises if one is already open."""
+        if self._tx_buf is not None:
+            raise RuntimeError("wal transaction scope already open")
+        if self._stash is not None:
+            self._flush_stash()
+        self._tx_buf = []
+
+    def tx_abort(self) -> None:
+        """Drop an open scope: nothing was framed, staged or forwarded —
+        the WAL and memtable look as if the scope never opened."""
+        if self._tx_buf is None:
+            return
+        if self._stash is not None:
+            self._flush_stash()  # diverted into the buffer being dropped
+        self._tx_buf = None
+
+    def tx_seal(self) -> int:
+        """Close the scope: frame every diverted op as ONE tx_batch record,
+        stage the sub-ops in the memtable, and return the record's LSN
+        (== mark(); callers barrier on flush([(mark0, lsn)]))."""
+        if self._stash is not None:
+            self._flush_stash()
+        ops, self._tx_buf = self._tx_buf, None
+        if not ops:
+            return self._lsn
+        lsn = self._lsn + 1
+        sub = [(OP_INDEX[name], args) for name, args in ops]
+        frame = encode_record(lsn, OP_INDEX["tx_batch"], (sub,))
+        self._lsn = lsn
+        self._buf.append(frame)
+        n = len(frame)
+        self._buf_bytes += n
+        self._buf_last_lsn = lsn
+        self._pending.extend(ops)
+        self._pending_bytes += n
+        if (self._pending_bytes >= self.memtable_bytes
+                and not self._drain_kicked and self._loop is not None):
+            self._drain_kicked = True
+            self._fire(self._drain())
+        m = self.metrics
+        m.wal_appends += 1
+        m.wal_append_bytes += n
+        m.wal_tx_batches += 1
+        m.wal_tx_batch_ops += len(ops)
+        if not self._wake.is_set():
+            self._wake.set()
+        return lsn
+
     # fire-and-forget hot path: append only, no future machinery — the
     # memtable overlay keeps the blob readable until the drain lands it.
     # insert_message_nowait holds the blob back (stash): the queue-log
@@ -1291,6 +1364,17 @@ class WalStore(StoreService):
 def _make_replay(name: str):
     if name == "worker_id_floor":
         return lambda inner, args: inner.worker_id_floor(args[0])
+    if name == "tx_batch":
+        def replay_tx(inner, args):
+            # args = ([(op_index, sub_args), ...],): apply every sub-op —
+            # the record is one frame, so recovery sees all of them or
+            # none (the all-or-nothing contract Tx.Commit rides on).
+            # _REPLAY_OPS resolves late: it exists by the time any replay
+            # runs, and a tx_batch never nests another tx_batch.
+            return asyncio.gather(*[
+                _REPLAY_OPS[op](inner, sub_args)
+                for op, sub_args in args[0] if op < len(_REPLAY_OPS)])
+        return replay_tx
     if name == "insert_published":
         def replay_published(inner, args):
             msg, vhost, queue, offset, body_size, expire_at_ms = args

@@ -278,6 +278,52 @@ BENCH_SECONDS=5 timeout -k 10 120 python bench.py --stream || {
     exit "$rc"
 }
 
+echo "tier1: rpc bench smoke (request-reply, exclusive reply queues)"
+BENCH_SECONDS=5 timeout -k 10 120 python bench.py --rpc || {
+    rc=$?
+    echo "tier1: rpc bench smoke FAILED (rc=$rc)" >&2
+    exit "$rc"
+}
+
+echo "tier1: dlx/priority scenario smoke (burst drain order + exactly-once DLX)"
+# the bench itself fails (exit 1) on any priority inversion, lost or
+# duplicated dead-letter, or malformed x-death header
+BENCH_SECONDS=5 timeout -k 10 240 python bench.py --dlx || {
+    rc=$?
+    echo "tier1: dlx/priority smoke FAILED (rc=$rc) — ordering or dead-letter violation" >&2
+    exit "$rc"
+}
+
+echo "tier1: semantics soak smoke (~8 s: Tx kill at the WAL boundary + TTL DLX under faults)"
+# the soak itself fails (violation -> exit 1) on confirmed loss, a
+# partially recovered transaction, post-rollback ghosts, or non-exactly-
+# once dead-lettering; the grep double-checks both same-seed repeats
+# serialized byte-identically
+timeout -k 10 300 python bench.py --semantics-soak --seed 42 \
+        | tee /tmp/_t1_semantics.json || {
+    rc=$?
+    echo "tier1: semantics soak smoke FAILED (rc=$rc) — delivery-semantics invariant violation" >&2
+    exit "$rc"
+}
+grep -q '"deterministic": true' /tmp/_t1_semantics.json || {
+    echo "tier1: semantics soak repeats were not byte-identical" >&2
+    exit 1
+}
+
+echo "tier1: semantics overhead smoke (5 s x2: disabled-path cost <= 2%)"
+ok=""
+for attempt in 1 2 3; do
+    if BENCH_SECONDS=5 timeout -k 10 120 python bench.py --semantics-overhead; then
+        ok=1
+        break
+    fi
+    echo "tier1: semantics overhead attempt $attempt over budget, retrying" >&2
+done
+[ -n "$ok" ] || {
+    echo "tier1: semantics overhead smoke FAILED (3 attempts) — semantics disabled-path cost over budget" >&2
+    exit 1
+}
+
 echo "tier1: route microbench smoke (tensor router vs trie, parity gate)"
 # the bench itself fails (exit 1) on any kernel/oracle parity mismatch or
 # a broken key-shared fan-out; the grep double-checks both batched paths

@@ -93,23 +93,37 @@ async def test_queue_reached_via_two_paths_gets_one_copy(client):
     assert await ch.basic_get("q_diamond", no_ack=True) is None
 
 
-async def test_cycle_is_safe(client):
+async def test_cycle_is_refused(client):
+    """A bind that would close a directed cycle is refused at declare
+    time with 406 PRECONDITION_FAILED (semantics/graph.py): the runtime
+    walk is cycle-safe, but a cyclic graph blocks closure flattening and
+    is almost certainly a client bug. The refusal must leave the
+    existing acyclic binding fully functional."""
     ch = await client.channel()
     await ch.exchange_declare("loop_a", "fanout")
     await ch.exchange_declare("loop_b", "fanout")
     await ch.queue_declare("q_a")
     await ch.queue_declare("q_b")
     await ch.exchange_bind("loop_b", "loop_a", "")
-    await ch.exchange_bind("loop_a", "loop_b", "")  # cycle
-    await ch.queue_bind("q_a", "loop_a", "")
-    await ch.queue_bind("q_b", "loop_b", "")
+    with pytest.raises(ChannelClosedError) as exc:
+        await ch.exchange_bind("loop_a", "loop_b", "")  # closes the cycle
+    assert "406" in str(exc.value)
 
-    ch.basic_publish(b"ring", exchange="loop_a", routing_key="")
-    assert [m.body for m in await drain(ch, "q_a", 1)] == [b"ring"]
-    assert [m.body for m in await drain(ch, "q_b", 1)] == [b"ring"]
-    await asyncio.sleep(0.05)
-    assert await ch.basic_get("q_a", no_ack=True) is None
-    assert await ch.basic_get("q_b", no_ack=True) is None
+    # the refusing channel closed; the surviving topology still routes
+    ch2 = await client.channel()
+    await ch2.queue_bind("q_a", "loop_a", "")
+    await ch2.queue_bind("q_b", "loop_b", "")
+    ch2.basic_publish(b"ring", exchange="loop_a", routing_key="")
+    assert [m.body for m in await drain(ch2, "q_a", 1)] == [b"ring"]
+    assert [m.body for m in await drain(ch2, "q_b", 1)] == [b"ring"]
+
+
+async def test_self_bind_is_refused(client):
+    ch = await client.channel()
+    await ch.exchange_declare("self_x", "fanout")
+    with pytest.raises(ChannelClosedError) as exc:
+        await ch.exchange_bind("self_x", "self_x", "")
+    assert "406" in str(exc.value)
 
 
 async def test_unbind_stops_flow(client):

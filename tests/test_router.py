@@ -286,7 +286,12 @@ def test_engine_defer_ok_gates(event_loop):
     assert router.defer_ok("/", "e2")
     event_loop.run_until_complete(
         broker.bind_exchange("/", "ex", "e2", "k"))
-    assert not router.defer_ok("/", "e2")         # e2e graph
+    assert router.defer_ok("/", "e2")             # e2e closure compiles
+    # wildcard hop over a wildcard sub-closure cannot flatten: the walk stays
+    event_loop.run_until_complete(broker.declare_exchange("/", "e3", "topic"))
+    event_loop.run_until_complete(
+        broker.bind_exchange("/", "ex", "e3", "x.*"))
+    assert not router.defer_ok("/", "e3")         # uncompilable e2e graph
 
 
 # ---------------------------------------------------------------------------
