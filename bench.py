@@ -1624,11 +1624,20 @@ async def _rpc_spec(clients: int = 4, servers: int = 2,
                 n += 1
             return n
 
+        # clients, servers and broker share this process: the CPU ledger
+        # sampled around the closed-loop window is the whole round-trip
+        # cost (publish + route + 2x deliver + ack), not broker-only
+        cpu0 = _proc_cpu_s(os.getpid())
         t0 = time.perf_counter()
         counts = await asyncio.gather(
             *(closed_loop(c) for c in rpc_clients))
         closed_wall = time.perf_counter() - t0
+        cpu1 = _proc_cpu_s(os.getpid())
         round_trips = sum(counts)
+        cpu_us_per_msg = (
+            round((cpu1 - cpu0) * 1e6 / round_trips, 3)
+            if cpu0 is not None and cpu1 is not None and round_trips
+            else None)
 
         # phase 2: paced, round-trip latency under a fixed offered rate
         async def paced_loop(c) -> list:
@@ -1661,6 +1670,7 @@ async def _rpc_spec(clients: int = 4, servers: int = 2,
             "servers": servers,
             "round_trips": round_trips,
             "round_trips_per_s": round(round_trips / closed_wall, 1),
+            "cpu_us_per_msg": cpu_us_per_msg,
             "served": served,
             "paced_rate_per_client": paced_rate,
             "paced_samples": len(lats),
@@ -1719,6 +1729,9 @@ async def _dlx_spec() -> dict:
             "x-dead-letter-exchange": "bench_dlx"})
 
         # phase 1: burst at shuffled priorities, drain in priority order
+        # (producer, broker and consumer share this process: the CPU
+        # window is the full publish->prio-dispatch->deliver cost)
+        cpu0 = _proc_cpu_s(os.getpid())
         t0 = time.perf_counter()
         for i in range(burst):
             ch.basic_publish(
@@ -1744,6 +1757,10 @@ async def _dlx_spec() -> dict:
         await asyncio.wait_for(done, timeout=60)
         await ch.basic_cancel(tag)
         burst_wall = time.perf_counter() - t0
+        cpu1 = _proc_cpu_s(os.getpid())
+        cpu_us_per_msg = (
+            round((cpu1 - cpu0) * 1e6 / burst, 3)
+            if cpu0 is not None and cpu1 is not None and burst else None)
 
         # phase 2: reject everything once -> exactly-once dead-lettering
         t1 = time.perf_counter()
@@ -1785,6 +1802,7 @@ async def _dlx_spec() -> dict:
         return {
             "burst": burst,
             "burst_drain_per_s": round(burst / burst_wall, 1),
+            "cpu_us_per_msg": cpu_us_per_msg,
             "dlx_msgs": dlx_msgs,
             "dlx_round_trip_per_s": round(dlx_msgs / dlx_wall, 1),
             "violations": violations,
@@ -2038,6 +2056,7 @@ def main() -> None:
         if "error" not in result:
             record = trajectory_record("rpc_4c2s", {
                 "delivered_per_s": result.get("round_trips_per_s"),
+                "cpu_us_per_msg": result.get("cpu_us_per_msg"),
                 "p50_us": result.get("paced_p50_us"),
                 "p99_us": result.get("paced_p99_us"),
             })
@@ -2068,6 +2087,7 @@ def main() -> None:
         if not result.get("error") and not result.get("violations"):
             record = trajectory_record("dlx_priority", {
                 "delivered_per_s": result.get("burst_drain_per_s"),
+                "cpu_us_per_msg": result.get("cpu_us_per_msg"),
             })
         if record is not None:
             trajectory_append(record)

@@ -24,6 +24,14 @@ from .constants import (
 
 _HEADER_STRUCT = struct.Struct(">BHI")
 
+# Packed egress record meta, 33 bytes little-endian, shared between the
+# broker's egress buffer and chana_encode_deliveries_packed (which memcpy's
+# the fields, so no alignment requirement):
+#   int32 channel | uint64 tag | uint8 redelivered |
+#   int32 prefix_len | int32 exrk_len | int32 header_len | int64 body_len
+# followed in the blob by prefix || exrk || header || body.
+ENC_META = struct.Struct("<iQBiiiq")
+
 
 @dataclass(frozen=True, slots=True)
 class Frame:
@@ -55,6 +63,50 @@ class Frame:
 
 HEARTBEAT_FRAME = Frame(FrameType.HEARTBEAT, 0, b"")
 HEARTBEAT_BYTES = HEARTBEAT_FRAME.to_bytes()
+
+
+def deliveries_wire_size(records: list, frame_max: int) -> int:
+    """Exact wire size of encode_deliveries(records, frame_max)."""
+    max_payload = frame_max - FRAME_HEADER_SIZE - 1 if frame_max else 0
+    total = 0
+    for _cid, prefix, _tag, _red, exrk, header, body in records:
+        total += 16 + len(prefix) + 9 + len(exrk) + len(header)
+        blen = len(body)
+        if blen:
+            chunks = -(-blen // max_payload) if frame_max else 1
+            total += blen + 8 * chunks
+    return total
+
+
+def encode_deliveries(records: list, frame_max: int) -> bytes:
+    """Pure-Python reference for chana_encode_deliveries: render a batch of
+    ``(channel_id, prefix, tag, redelivered, exrk, header, body)`` delivery
+    records (prefix = the basic.deliver method payload up to the delivery
+    tag, exrk = length-prefixed exchange + routing-key, header = encoded
+    content-header payload) into one contiguous wire buffer. Body frames
+    split at frame_max - 8; frame_max 0 means no splitting. Used as the
+    egress fallback when the native encoder is unavailable, and as the
+    parity oracle in tests (byte-identical output is a test invariant)."""
+    pack = _HEADER_STRUCT.pack
+    parts: list = []
+    for cid, prefix, tag, redelivered, exrk, header, body in records:
+        method_payload = b"".join((
+            prefix, tag.to_bytes(8, "big"),
+            b"\x01" if redelivered else b"\x00", exrk))
+        parts += (
+            pack(1, cid, len(method_payload)), method_payload, b"\xce",
+            pack(2, cid, len(header)), header, b"\xce",
+        )
+        if body:
+            max_payload = (frame_max - FRAME_HEADER_SIZE - 1) if frame_max \
+                else len(body)
+            if len(body) <= max_payload:
+                parts += (pack(3, cid, len(body)), body, b"\xce")
+            else:
+                for off in range(0, len(body), max_payload):
+                    chunk = body[off:off + max_payload]
+                    parts += (pack(3, cid, len(chunk)), chunk, b"\xce")
+    return b"".join(parts)
 
 
 @dataclass(frozen=True, slots=True)

@@ -93,10 +93,26 @@ class Broker:
         router_verify: bool = False,
         semantics_enabled: bool = True,
         delay_tick_ms: int = 50,
+        native_egress: bool = True,
+        native_pool_buffers: int = 16,
+        native_pool_buffer_kb: int = 256,
     ) -> None:
         self.store = store or MemoryStore()
         self.idgen = IdGenerator(node_id)
         self.metrics = Metrics()
+        # native batch egress (chana.mq.native.*): the process-wide
+        # encoder + buffer-pool singleton, or None when the native
+        # pipeline is unavailable / disabled — connections snapshot this
+        # at accept time and fall back to per-delivery Python rendering
+        # when None
+        self.egress_encoder = None
+        if native_egress:
+            from .. import native_ext
+            self.egress_encoder = native_ext.egress_encoder(
+                native_pool_buffers, native_pool_buffer_kb)
+        # connections holding un-rendered delivery records; queue dispatch
+        # flushes them at pass end (inside the dispatch ledger window)
+        self.egress_dirty: set = set()
         self.vhosts: dict[str, VHost] = {}
         # set by chanamq_tpu.cluster.node.ClusterNode when clustering is on
         self.cluster = None

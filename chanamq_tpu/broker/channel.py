@@ -226,9 +226,24 @@ class ServerChannel:
             tr = msg.trace
             if tr is not None:
                 t_del = time.perf_counter_ns()
-        self.connection.send_bytes(
-            self._render_deliver(consumer, tag, qm.redelivered, msg, body))
-        self.connection.delivered_msgs += 1
+        conn = self.connection
+        if conn._egress is not None:
+            # native batch egress: buffer the record, render the whole
+            # dispatch pass in one chana_encode_deliveries call at the
+            # flush point (connection.flush_egress)
+            exrk = msg.exrk_raw
+            if exrk is None:
+                ex = msg.exchange.encode("utf-8")
+                rk = msg.routing_key.encode("utf-8")
+                exrk = msg.exrk_raw = (
+                    bytes((len(ex),)) + ex + bytes((len(rk),)) + rk)
+            conn.egress_deliver(
+                self.id, consumer._deliver_prefix, tag, qm.redelivered,
+                exrk, msg.header_payload(), body)
+        else:
+            conn.send_bytes(
+                self._render_deliver(consumer, tag, qm.redelivered, msg, body))
+        conn.delivered_msgs += 1
         if self.connection.broker.flow_consumer_buffer:
             consumer.buffered_bytes += len(body)
         metrics = self.connection.broker.metrics

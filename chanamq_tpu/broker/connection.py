@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import socket
 import time
 import uuid
 from typing import Optional
@@ -40,9 +42,17 @@ from ..amqp.constants import (
     FrameType,
     PROTOCOL_HEADER,
 )
-from ..amqp.frame import Frame, FrameError, FrameParser, HEARTBEAT_BYTES
+from ..amqp.frame import (
+    Frame,
+    FrameError,
+    FrameParser,
+    HEARTBEAT_BYTES,
+    deliveries_wire_size,
+    encode_deliveries,
+)
 from ..amqp import methods as am
 from ..amqp.properties import BasicProperties
+from ..amqp.frame import ENC_META as _ENC_META
 from .. import events, profile, trace
 from .broker import Broker, BrokerError
 from .channel import ChannelMode, Consumer, ServerChannel
@@ -71,6 +81,23 @@ LOCALES = b"en_US"
 # consumers; below low, dispatch resumes (SURVEY.md §7.3 "backpressure")
 WRITE_HIGH_WATERMARK = 4 * 1024 * 1024
 WRITE_LOW_WATERMARK = 1 * 1024 * 1024
+
+# native batch egress: deliveries pending in a flush batch below this count
+# render through the Python fallback — under ~4 records the ctypes argument
+# marshalling costs more than the per-record b"".join it replaces
+_EGRESS_MIN_BATCH = 4
+
+# packed egress record meta (see native_ext._ENC_META): egress_deliver packs
+# each record's header at buffer time so the flush is a single join + one
+# native call with no per-record marshalling
+_ENC_META_PACK = _ENC_META.pack
+_ENC_META_UNPACK = _ENC_META.unpack
+
+# scatter-gather egress: buffers per sendmsg call (Linux UIO_MAXIOV is 1024;
+# stay under it and let the partial-write loop take further rounds)
+_IOV_MAX = 512
+_WRITEV_ENABLED = hasattr(os, "writev") and os.environ.get(
+    "CHANAMQ_NATIVE_WRITEV", "1") not in ("0", "false", "no")
 
 # method-frame payload prefixes the scan hot loop recognizes before any
 # decode: Basic.Publish (class 60, method 40) and Basic.Ack (60, 80)
@@ -174,8 +201,30 @@ class AMQPConnection:
         # see them (chana.mq.message.max-size; RabbitMQ's analogue caps
         # at 512 MiB, default 128 MiB)
         self._assembler = CommandAssembler(max_body_size=max_message_size)
-        self._out = bytearray()
+        # output path: a list of pending wire buffers (bytes appended via
+        # send_bytes coalesce into a bytearray tail; batch-encoded egress
+        # appends pooled memoryviews) drained by the writer task as ONE
+        # scatter-gather sendmsg per wakeup. _out_bytes tracks the list's
+        # total so the watermarks stay O(1); _out_pooled holds the arena
+        # slot ids riding in _out, released once the kernel write lands.
+        self._out: list = []
+        self._out_bytes = 0
+        self._out_pooled: list[int] = []
         self._out_event = asyncio.Event()
+        # raw socket for the scatter-gather writer (resolved in serve();
+        # None = TLS or non-socket transport, writer falls back to
+        # join + StreamWriter.write)
+        self._sock = None
+        # native batch egress: deliveries buffered as flat packed parts
+        # (_ENC_META header + prefix/exrk/header/body slices, 5 parts per
+        # record) and rendered in one chana_encode_deliveries_packed call
+        # at the dispatch-pass flush (or the call_soon guard for
+        # off-dispatch paths: streams, cluster stubs)
+        self._egress = broker.egress_encoder
+        self._egress_pending: list = []
+        self._egress_records = 0
+        self._egress_bytes = 0
+        self._egress_guard_scheduled = False
         self._writer_task: Optional[asyncio.Task] = None
         self._heartbeat_task: Optional[asyncio.Task] = None
         self._last_recv = time.monotonic()
@@ -267,12 +316,21 @@ class AMQPConnection:
 
     @property
     def write_saturated(self) -> bool:
-        return len(self._out) >= WRITE_HIGH_WATERMARK
+        return self._out_bytes + self._egress_bytes >= WRITE_HIGH_WATERMARK
 
     def send_bytes(self, data: bytes) -> None:
         if self.closing:
             return
-        self._out += data
+        if self._egress_pending:
+            # wire-order invariant: buffered deliveries precede any frame
+            # rendered after them (confirms, method replies, heartbeats)
+            self.flush_egress()
+        out = self._out
+        if out and type(out[-1]) is bytearray:
+            out[-1] += data
+        else:
+            out.append(bytearray(data))
+        self._out_bytes += len(data)
         self._out_event.set()
 
     def send_command(self, command: AMQCommand) -> None:
@@ -281,31 +339,194 @@ class AMQPConnection:
     def send_method(self, channel: int, method: am.Method) -> None:
         self.send_bytes(Frame.method(channel, method.encode()).to_bytes())
 
+    # -- native batch egress -------------------------------------------
+
+    def egress_deliver(self, channel_id: int, prefix: bytes, tag: int,
+                       redelivered: bool, exrk: bytes, header: bytes,
+                       body: bytes) -> None:
+        """Buffer one basic.deliver as packed parts instead of rendering
+        it: the whole batch renders in one native
+        chana_encode_deliveries_packed call at the flush point
+        (dispatch-pass end for classic queues — inside the
+        dispatch/deliver ledger window — or the call_soon guard for
+        stream/cluster delivery paths)."""
+        pend = self._egress_pending
+        if not pend:
+            self.broker.egress_dirty.add(self)
+            if not self._egress_guard_scheduled:
+                self._egress_guard_scheduled = True
+                asyncio.get_event_loop().call_soon(self._egress_guard)
+        plen = len(prefix)
+        elen = len(exrk)
+        hlen = len(header)
+        blen = len(body)
+        pend += (_ENC_META_PACK(channel_id, tag, 1 if redelivered else 0,
+                                plen, elen, hlen, blen),
+                 prefix, exrk, header, body)
+        self._egress_records += 1
+        # exact wire size, tracked so write_saturated (dispatch
+        # backpressure) sees buffered records the moment they queue
+        size = 25 + plen + elen + hlen
+        if blen:
+            frame_max = self.frame_max
+            if frame_max:
+                size += blen + 8 * -(-blen // (frame_max - 8))
+            else:
+                size += blen + 8
+        self._egress_bytes += size
+
+    def _egress_guard(self) -> None:
+        # safety net for deliveries buffered outside a queue dispatch pass
+        # (stream cursors, cluster stub renders): runs on the next loop
+        # iteration, after the dispatch-end flush has usually already
+        # drained the batch
+        self._egress_guard_scheduled = False
+        if self._egress_pending:
+            self.flush_egress()
+
+    def flush_egress(self) -> None:
+        """Render the buffered delivery records into the output list: one
+        native batch encode into a pooled arena buffer when the batch is
+        worth it, the pure-Python encode_deliveries fallback otherwise.
+        Synchronous — callable from any point of dispatch or batch
+        processing without yielding the loop."""
+        pend = self._egress_pending
+        if not pend:
+            return
+        self._egress_pending = []
+        nrec = self._egress_records
+        self._egress_records = 0
+        nbytes = self._egress_bytes
+        self._egress_bytes = 0
+        self.broker.egress_dirty.discard(self)
+        if self.closing:
+            return
+        metrics = self.broker.metrics
+        enc = self._egress
+        buf = None
+        slot = -1
+        if enc is not None and nrec >= _EGRESS_MIN_BATCH:
+            res = enc.encode_packed(pend, nrec, self.frame_max, nbytes)
+            if res is not None:
+                buf, slot = res
+                if slot < 0 and nbytes > enc.buf_bytes:
+                    # oversized batch went to the heap by design, not
+                    # because the arena ran dry
+                    pass
+                elif slot < 0:
+                    metrics.native_pool_exhausted += 1
+            else:  # pragma: no cover - size-mismatch defense
+                metrics.native_egress_fallbacks += 1
+        if buf is None:
+            # small batch / no encoder: rebuild records off the packed
+            # parts (5 per record) for the pure-Python renderer
+            records = []
+            for j in range(0, len(pend), 5):
+                cid, tag, red, _pl, _el, _hl, _bl = _ENC_META_UNPACK(pend[j])
+                records.append((cid, pend[j + 1], tag, red, pend[j + 2],
+                                pend[j + 3], pend[j + 4]))
+            buf = encode_deliveries(records, self.frame_max)
+        else:
+            metrics.native_egress_batches += 1
+            metrics.native_egress_msgs += nrec
+            metrics.native_egress_bytes += nbytes
+        out = self._out
+        if slot >= 0:
+            self._out_pooled.append(slot)
+            out.append(buf)
+        elif type(buf) is bytearray:
+            out.append(buf)  # native heap encode: already its own buffer
+        elif out and type(out[-1]) is bytearray:
+            out[-1] += buf
+        else:
+            out.append(bytearray(buf))
+        self._out_bytes += nbytes
+        self._out_event.set()
+
+    # -- writer task ----------------------------------------------------
+
     async def _writer_loop(self) -> None:
         try:
             while True:
                 await self._out_event.wait()
                 self._out_event.clear()
                 if self._out:
-                    data = bytes(self._out)
-                    self._out.clear()
-                    was_saturated = len(data) >= WRITE_HIGH_WATERMARK
-                    self.writer.write(data)
+                    bufs = self._out
+                    pooled = self._out_pooled
+                    nbytes = self._out_bytes
+                    self._out = []
+                    self._out_pooled = []
+                    self._out_bytes = 0
+                    was_saturated = nbytes >= WRITE_HIGH_WATERMARK
+                    try:
+                        await self._write_bufs(bufs)
+                    finally:
+                        # arena slots return to the pool even when the
+                        # write dies mid-flight (connection teardown
+                        # awaits/cancels this task before closing)
+                        if pooled:
+                            enc = self._egress
+                            for slot in pooled:
+                                enc.release(slot)
                     self._last_send = time.monotonic()
-                    await self.writer.drain()
                     if not self._out and self.broker.flow_consumer_buffer:
                         # fully drained to the kernel: whatever this
                         # connection's consumers had buffered is on the
                         # wire — reset their delivery-buffer accounting
                         self._reset_consumer_buffers()
-                    if was_saturated and len(self._out) < WRITE_LOW_WATERMARK:
+                    if was_saturated and (self._out_bytes
+                                          < WRITE_LOW_WATERMARK):
                         self._resume_dispatch()
                 if self.closing and not self._out:
                     break
-        except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
-            # dead peer: mark closing so a main loop parked at the memory
-            # gate (not reading, hence blind to the hangup) still exits
+        except (ConnectionResetError, BrokenPipeError, OSError, ValueError,
+                asyncio.CancelledError):
+            # dead peer (or the socket closed under us mid-write): mark
+            # closing so a main loop parked at the memory gate (not
+            # reading, hence blind to the hangup) still exits
             self.closing = True
+
+    async def _write_bufs(self, bufs: list) -> None:
+        """One writer wakeup's kernel hand-off: scatter-gather writev of
+        the pending buffer list on plain TCP/UDS sockets (no concatenation
+        copy), StreamWriter.write + drain otherwise (TLS, test doubles).
+
+        asyncio forbids a second add_writer on a transport-owned fd, so a
+        full kernel buffer (EAGAIN) spills the remainder into the transport
+        — which owns the fd's writability callback — and writev resumes
+        once the transport reports its buffer drained."""
+        sock = self._sock
+        if sock is None or self.writer.transport.get_write_buffer_size():
+            self.writer.write(b"".join(bufs))
+            await self.writer.drain()
+            return
+        fd = sock.fileno()
+        idx = 0
+        total = len(bufs)
+        while idx < total:
+            batch = bufs[idx:idx + _IOV_MAX]
+            try:
+                sent = os.writev(fd, batch)
+            except InterruptedError:
+                continue
+            except BlockingIOError:
+                self.writer.write(b"".join(bufs[idx:]))
+                await self.writer.drain()
+                return
+            while sent > 0:
+                blen = len(bufs[idx])
+                if sent >= blen:
+                    sent -= blen
+                    idx += 1
+                else:
+                    # partial buffer: keep the unsent tail (memoryview
+                    # slicing is zero-copy for both bytearray and pooled
+                    # arena buffers)
+                    mv = bufs[idx]
+                    if type(mv) is not memoryview:
+                        mv = memoryview(mv)
+                    bufs[idx] = mv[sent:]
+                    sent = 0
 
     def _resume_dispatch(self) -> None:
         for channel in self.channels.values():
@@ -330,6 +551,23 @@ class AMQPConnection:
     async def serve(self) -> None:
         """Run the connection to completion."""
         self.broker.metrics.connections_opened += 1
+        sock = self.writer.get_extra_info("socket")
+        if sock is not None and hasattr(sock, "setsockopt"):
+            try:
+                # disable Nagle: deliver/confirm frames are small writes
+                # and must not wait on the peer's delayed ACK (the batch
+                # egress already coalesces a dispatch pass into one
+                # writev, so there is nothing left for Nagle to batch)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass  # unix socket / exotic family: no Nagle to disable
+        if _WRITEV_ENABLED and self.writer.get_extra_info("ssl_object") is None:
+            # plain TCP/UDS stream: the writer drains via scatter-gather
+            # sendmsg on the raw socket (every steady-state byte goes
+            # through _out, so the transport's own buffer stays empty and
+            # direct socket writes can't interleave with it)
+            if sock is not None and hasattr(sock, "fileno"):
+                self._sock = sock
         self._writer_task = asyncio.create_task(self._writer_loop())
         self.broker.blocked_listeners.add(self._on_memory_blocked)
         self.broker.flow_stage_listeners.add(self._on_flow_stage)
@@ -805,7 +1043,8 @@ class AMQPConnection:
             if isinstance(batch, FrameError):
                 await self._hard_close(batch.code, batch.message)
                 return False
-            raw, n, types, channels, offsets, lengths = batch
+            raw, n, types, channels, offsets, lengths, pub_mark, body_off, \
+                body_len = batch
             i = 0
             while i < n:
                 ftype = types[i]
@@ -820,14 +1059,25 @@ class AMQPConnection:
                         and not self._throttled):
                     consumed = 0
                     try:
-                        sig = raw[off:off + 4]
-                        if (sig == _PUBLISH_SIG and i + 1 < n
-                                and types[i + 1] == 2
-                                and channels[i + 1] == channel_id):
-                            consumed = self._fused_publish(
-                                raw, i, n, types, channels, offsets, lengths)
-                        elif sig == _ACK_SIG and lengths[i] == 13:
-                            consumed = self._fused_ack(raw, off, channel_id)
+                        mark = pub_mark[i]
+                        if mark:
+                            # the native scanner already validated the
+                            # complete METHOD/HEADER/BODY publish triple:
+                            # no sig compare, no shape walk, one body slice
+                            consumed = self._fused_publish_marked(
+                                raw, i, mark, channel_id, off, offsets,
+                                lengths, body_off, body_len)
+                        else:
+                            sig = raw[off:off + 4]
+                            if (sig == _PUBLISH_SIG and i + 1 < n
+                                    and types[i + 1] == 2
+                                    and channels[i + 1] == channel_id):
+                                consumed = self._fused_publish(
+                                    raw, i, n, types, channels, offsets,
+                                    lengths)
+                            elif sig == _ACK_SIG and lengths[i] == 13:
+                                consumed = self._fused_ack(
+                                    raw, off, channel_id)
                     except HardError as exc:
                         await self._hard_close(
                             exc.code, exc.text, exc.class_id, exc.method_id)
@@ -877,6 +1127,108 @@ class AMQPConnection:
         # raises a proper access-refused channel error.
         return self._opened and not self._closing_channels and self._can_write
 
+    @staticmethod
+    def _publish_args(payload: bytes):
+        """Decode (exchange, routing_key, exrk_raw) off a Basic.Publish
+        method payload through the adaptive args cache. None -> generic
+        path (truncated payload, or mandatory/immediate bits that need a
+        Return render)."""
+        global _publish_cache_strikes
+        caching = _publish_cache_strikes < _PUBLISH_CACHE_STRIKES
+        if caching:
+            args_key = payload[6:]
+            cached = _PUBLISH_ARGS_CACHE.get(args_key)
+            if cached is not None:
+                return cached
+        try:
+            exchange, routing_key, bits, pos = am.parse_publish_wire(payload)
+        except (IndexError, UnicodeDecodeError, am.MethodDecodeError):
+            return None  # truncated/bad payload: generic path raises properly
+        if bits:
+            return None  # mandatory / immediate: generic path renders Returns
+        exrk_raw = payload[6:pos]
+        if caching:
+            if len(_PUBLISH_ARGS_CACHE) >= 1024:
+                _PUBLISH_ARGS_CACHE.clear()
+                _publish_cache_strikes += 1
+            if _publish_cache_strikes < _PUBLISH_CACHE_STRIKES:
+                _PUBLISH_ARGS_CACHE[args_key] = (
+                    exchange, routing_key, exrk_raw)
+        return exchange, routing_key, exrk_raw
+
+    @staticmethod
+    def _publish_props(header: bytes) -> Optional[BasicProperties]:
+        """Decode BasicProperties off a raw content-header payload through
+        the adaptive header cache. None -> generic path (the assembler
+        raises the proper SYNTAX_ERROR)."""
+        global _header_cache_strikes
+        caching = _header_cache_strikes < _PUBLISH_CACHE_STRIKES
+        if caching:
+            props = _HEADER_CACHE.get(header)
+            if props is not None:
+                return props
+        try:
+            _class_id, _size, props = BasicProperties.decode_header(header)
+        except Exception:
+            return None
+        if caching:
+            if len(_HEADER_CACHE) >= 1024:
+                _HEADER_CACHE.clear()
+                _header_cache_strikes += 1
+            if _header_cache_strikes < _PUBLISH_CACHE_STRIKES:
+                _HEADER_CACHE[header] = props
+        return props
+
+    def _fused_publish_marked(
+        self, raw, i, mark, channel_id, moff, offsets, lengths, body_off,
+        body_len
+    ) -> int:
+        """Marked fast lane: chana_scan_publish already proved frames
+        i..i+mark-1 form a complete plain Basic.Publish triple on one
+        channel, so this skips the signature compare, the shape checks,
+        and the body-gather walk — one slice per wire field. Cache hits
+        (the steady-state flow: same exchange+rk, same header shape) are
+        checked inline to skip the decode-helper calls entirely. Returns
+        the frames consumed or 0 to fall back (TX channel, unknown
+        channel, over the size cap, clustered route-cache miss)."""
+        payload = raw[moff:moff + lengths[i]]
+        if _publish_cache_strikes < _PUBLISH_CACHE_STRIKES:
+            args = _PUBLISH_ARGS_CACHE.get(payload[6:])
+        else:
+            args = None
+        if args is None:
+            args = self._publish_args(payload)
+            if args is None:
+                return 0
+        exchange, routing_key, exrk_raw = args
+        channel = self.channels.get(channel_id)
+        if channel is None:
+            return 0  # full path raises the proper channel error
+        if channel.mode is ChannelMode.TX:
+            return 0  # transactional publish: generic path buffers it
+        hoff = offsets[i + 1]
+        header = raw[hoff:hoff + lengths[i + 1]]
+        blen = body_len[i]
+        max_body = self._assembler.max_body_size
+        if max_body and blen > max_body:
+            return 0  # over the message-size cap: the assembler raises 501
+        if blen:
+            boff = body_off[i]
+            body = raw[boff:boff + blen]
+        else:
+            body = b""
+        if _header_cache_strikes < _PUBLISH_CACHE_STRIKES:
+            props = _HEADER_CACHE.get(header)
+        else:
+            props = None
+        if props is None:
+            props = self._publish_props(header)
+            if props is None:
+                return 0
+        return self._publish_fused_tail(
+            channel, channel_id, exchange, routing_key, props, body,
+            header, exrk_raw, mark)
+
     def _fused_publish(
         self, raw, i, n, types, channels, offsets, lengths
     ) -> int:
@@ -886,32 +1238,15 @@ class AMQPConnection:
         immediate bits, body spanning into the next read, interleaved
         channels, unknown channel). Semantics mirror _try_fast_publish —
         same publish_sync call, same confirm arming — minus the Return
-        cases, which the bit check routes to the fallback."""
+        cases, which the bit check routes to the fallback. The common
+        single-body-frame shape never lands here anymore — chana_scan_publish
+        marks it and _fused_publish_marked takes it; this path keeps the
+        multi-body-frame (within one read batch) publishes fused."""
         moff = offsets[i]
-        global _publish_cache_strikes
-        payload = raw[moff:moff + lengths[i]]
-        cached = None
-        caching = _publish_cache_strikes < _PUBLISH_CACHE_STRIKES
-        if caching:
-            args_key = payload[6:]
-            cached = _PUBLISH_ARGS_CACHE.get(args_key)
-        if cached is not None:
-            exchange, routing_key, exrk_raw = cached
-        else:
-            try:
-                exchange, routing_key, bits, pos = am.parse_publish_wire(payload)
-            except (IndexError, UnicodeDecodeError, am.MethodDecodeError):
-                return 0  # truncated/bad payload: generic path raises properly
-            if bits:
-                return 0  # mandatory / immediate: generic path renders Returns
-            exrk_raw = payload[6:pos]
-            if caching:
-                if len(_PUBLISH_ARGS_CACHE) >= 1024:
-                    _PUBLISH_ARGS_CACHE.clear()
-                    _publish_cache_strikes += 1
-                if _publish_cache_strikes < _PUBLISH_CACHE_STRIKES:
-                    _PUBLISH_ARGS_CACHE[args_key] = (
-                        exchange, routing_key, exrk_raw)
+        args = self._publish_args(raw[moff:moff + lengths[i]])
+        if args is None:
+            return 0
+        exchange, routing_key, exrk_raw = args
         channel = self.channels.get(channels[i])
         if channel is None:
             return 0  # full path raises the proper channel error
@@ -949,22 +1284,20 @@ class AMQPConnection:
                 j += 1
             body = first if chunks is None else b"".join(chunks)
             consumed = j - i
-        global _header_cache_strikes
-        props = None
-        header_caching = _header_cache_strikes < _PUBLISH_CACHE_STRIKES
-        if header_caching:
-            props = _HEADER_CACHE.get(header)
+        props = self._publish_props(header)
         if props is None:
-            try:
-                _class_id, _size, props = BasicProperties.decode_header(header)
-            except Exception:
-                return 0  # generic path raises the proper SYNTAX_ERROR
-            if header_caching:
-                if len(_HEADER_CACHE) >= 1024:
-                    _HEADER_CACHE.clear()
-                    _header_cache_strikes += 1
-                if _header_cache_strikes < _PUBLISH_CACHE_STRIKES:
-                    _HEADER_CACHE[header] = props
+            return 0
+        return self._publish_fused_tail(
+            channel, channel_id, exchange, routing_key, props, body,
+            header, exrk_raw, consumed)
+
+    def _publish_fused_tail(
+        self, channel, channel_id, exchange, routing_key, props, body,
+        header, exrk_raw, consumed
+    ) -> int:
+        """Shared back half of the fused publish lanes: tenant spend,
+        router deferral / publish_sync / clustered fast push, confirm
+        arming — identical semantics to the pre-split _fused_publish."""
         # count the skip before publish: the except handlers in
         # _consume_scan resume past this publish's frames on soft errors
         self._fused_skip = consumed
@@ -1262,12 +1595,25 @@ class AMQPConnection:
         self.exclusive_queues.clear()
         if self._heartbeat_task:
             self._heartbeat_task.cancel()
+        # buffered deliveries die with the connection (same as bytes
+        # already in _out): drop the records and their dirty registration
+        self._egress_pending.clear()
+        self._egress_records = 0
+        self._egress_bytes = 0
+        self.broker.egress_dirty.discard(self)
         if self._writer_task:
             self._out_event.set()
             try:
                 await asyncio.wait_for(self._writer_task, timeout=2)
             except (asyncio.TimeoutError, asyncio.CancelledError):
                 self._writer_task.cancel()
+        if self._out_pooled:
+            # arena slots still riding an unwritten _out (writer died or
+            # timed out): return them so the pool doesn't bleed capacity
+            enc = self._egress
+            for slot in self._out_pooled:
+                enc.release(slot)
+            self._out_pooled = []
         try:
             self.writer.close()
             await self.writer.wait_closed()

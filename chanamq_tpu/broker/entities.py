@@ -663,6 +663,16 @@ class Queue:
             if self.repl is not None:
                 self.repl.append(
                     "unacks", {"rows": [list(r) for r in new_unacks]})
+        # native batch egress: render every connection's buffered delivery
+        # records now, INSIDE the dispatch ledger window, so the encode
+        # cost stays attributed to dispatch/deliver (the per-connection
+        # call_soon guard only catches deliveries buffered outside a
+        # dispatch pass — streams, cluster stubs)
+        dirty = self.broker.egress_dirty
+        if dirty:
+            for conn in list(dirty):
+                conn.flush_egress()
+            dirty.clear()
         if prof is not None:
             dt = time.thread_time_ns() - t_pass
             sns, sc = prof.stage_ns, prof.stage_calls

@@ -280,6 +280,11 @@ class BrokerServer:
             semantics_enabled=config.bool("chana.mq.semantics.enabled"),
             delay_tick_ms=max(1, round((config.duration_s(
                 "chana.mq.semantics.delay-tick") or 0.05) * 1000)),
+            native_egress=config.bool("chana.mq.native.egress"),
+            native_pool_buffers=config.int("chana.mq.native.pool-buffers")
+            or 16,
+            native_pool_buffer_kb=config.int("chana.mq.native.pool-buffer-kb")
+            or 256,
         )
         if store is not None and hasattr(store, "metrics"):
             # the WAL engine's wal_* counters must land in the broker

@@ -10,6 +10,7 @@ from the RabbitMQ Java client plus its own ClientSettings
 from __future__ import annotations
 
 import asyncio
+import socket as socket_module
 import ssl as ssl_module
 import struct
 from collections import deque
@@ -214,6 +215,14 @@ class AMQPClient:
     ) -> "AMQPClient":
         self = cls()
         self.reader, self.writer = await asyncio.open_connection(host, port, ssl=ssl)
+        sock = self.writer.get_extra_info("socket")
+        if sock is not None and hasattr(sock, "setsockopt"):
+            try:
+                # small publish/ack writes must not wait on Nagle
+                sock.setsockopt(
+                    socket_module.IPPROTO_TCP, socket_module.TCP_NODELAY, 1)
+            except OSError:
+                pass
         self.writer.write(PROTOCOL_HEADER)
         await self.writer.drain()
         self._reader_task = asyncio.create_task(self._read_loop())
@@ -375,7 +384,7 @@ class AMQPClient:
                 await self._shutdown(
                     ConnectionClosedError(int(batch.code), batch.message))
                 return False
-            raw, n, types, channels, offsets, lengths = batch
+            raw, n, types, channels, offsets, lengths = batch[:6]
             i = 0
             while i < n:
                 ftype = types[i]

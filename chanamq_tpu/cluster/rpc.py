@@ -34,6 +34,7 @@ import asyncio
 import logging
 import os
 import random
+import socket
 import struct
 from io import BytesIO
 from typing import Awaitable, Callable, Optional, Union
@@ -55,6 +56,18 @@ KIND_DEVENT = 6
 
 _HEAD = struct.Struct(">IQB")
 MAX_FRAME = 64 * 1024 * 1024
+
+
+def _set_nodelay(writer: asyncio.StreamWriter) -> None:
+    """Disable Nagle on a TCP interconnect stream: RPC requests and
+    data-plane pushes are small framed writes whose latency must not
+    ride on the peer's delayed ACK (UDS transports no-op here)."""
+    sock = writer.get_extra_info("socket")
+    if sock is not None and hasattr(sock, "setsockopt"):
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
 
 Handler = Callable[[dict], Awaitable[Optional[dict]]]
 # binary handler: memoryview payload -> response payload parts (None = ok)
@@ -171,7 +184,9 @@ class TcpTransport(Transport):
         return f"{self.host}:{self.port}"
 
     async def dial(self):
-        return await asyncio.open_connection(self.host, self.port)
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        _set_nodelay(writer)
+        return reader, writer
 
     def __repr__(self) -> str:
         return f"TcpTransport({self.label})"
@@ -285,6 +300,7 @@ class RpcServer:
     async def _on_client(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        _set_nodelay(writer)
         self._peer_writers.add(writer)
         try:
             while True:

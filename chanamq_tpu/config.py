@@ -343,6 +343,19 @@ DEFAULTS: dict[str, Any] = {
     # timer-wheel granularity for x-delay delayed delivery: fires land
     # within one tick after their delay elapses
     "chana.mq.semantics.delay-tick": "50ms",
+    # native batch egress (native/chanamq_native.cpp): basic.deliver
+    # records from a dispatch pass render in ONE chana_encode_deliveries
+    # call into a pooled native buffer, and the connection writer drains
+    # its buffer list with scatter-gather sendmsg. Off (or a missing /
+    # stale native lib, or CHANAMQ_NATIVE=0) restores per-delivery Python
+    # rendering; wire bytes are identical either way.
+    "chana.mq.native.egress": True,
+    # egress arena sizing: buffers x buffer-kb is the pooled memory the
+    # process reserves (defaults: 16 x 256 KiB = 4 MiB); batches larger
+    # than one buffer, or arriving while the pool is dry, fall back to a
+    # fresh heap buffer (native_pool_exhausted counts the dry acquires)
+    "chana.mq.native.pool-buffers": 16,
+    "chana.mq.native.pool-buffer-kb": 256,
     # continuous profiling (chanamq_tpu/profile/): disabled by default —
     # every hot-path seam stays a module-level `ACTIVE is None` check.
     # Enabled, the per-message cost ledger accumulates per-stage CPU-ns

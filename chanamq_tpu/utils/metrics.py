@@ -218,6 +218,16 @@ class Metrics:
         self.router_fallback_msgs = 0
         self.router_parity_mismatches = 0
         self.router_batch_size = Histogram()
+        # native batch egress (native/chanamq_native.cpp): delivery
+        # batches rendered by chana_encode_deliveries, the messages and
+        # wire bytes they covered, pool-dry acquires that fell back to a
+        # heap buffer, and defensive encode fallbacks to the Python
+        # renderer (a size disagreement — never expected)
+        self.native_egress_batches = 0
+        self.native_egress_msgs = 0
+        self.native_egress_bytes = 0
+        self.native_egress_fallbacks = 0
+        self.native_pool_exhausted = 0
         # continuous profiling (chanamq_tpu/profile/): stack-sampler
         # samples taken, event-loop callbacks caught over the slow
         # threshold, and collector pauses seen by the gc hook. All zero
@@ -418,6 +428,11 @@ class Metrics:
             "router_batch_size_p50": self.router_batch_size.percentile_us(0.50),
             "router_batch_size_p99": self.router_batch_size.percentile_us(0.99),
             "router_batch_size_mean": self.router_batch_size.mean_us,
+            "native_egress_batches": self.native_egress_batches,
+            "native_egress_msgs": self.native_egress_msgs,
+            "native_egress_bytes": self.native_egress_bytes,
+            "native_egress_fallbacks": self.native_egress_fallbacks,
+            "native_pool_exhausted": self.native_pool_exhausted,
             "profile_samples_total": self.profile_samples_total,
             "profile_slow_callbacks_total": self.profile_slow_callbacks_total,
             "profile_gc_pauses_total": self.profile_gc_pauses_total,

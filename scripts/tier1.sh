@@ -7,6 +7,42 @@
 set -u
 cd "$(dirname "$0")/.."
 
+# Native pipeline gate: rebuild the library from a clean tree so the suite
+# below exercises the freshly-built scanner/encoder (a stale .so silently
+# falling back to Python would pass every parity test while benching the
+# wrong thing). Parity fuzz runs under BOTH backends: native on, and
+# CHANAMQ_NATIVE=0 for the pure-Python twin the fallback path depends on.
+if command -v g++ >/dev/null 2>&1 || command -v c++ >/dev/null 2>&1; then
+    echo "tier1: native rebuild from clean"
+    make -C native clean && make -C native || {
+        rc=$?
+        echo "tier1: native build FAILED (rc=$rc)" >&2
+        exit "$rc"
+    }
+    python - <<'EOF' || { echo "tier1: native pipeline unavailable after clean build" >&2; exit 1; }
+from chanamq_tpu import native_ext
+assert native_ext.available(), "native library failed to load"
+assert native_ext.pipeline_available(), "pipeline entry points missing"
+EOF
+    echo "tier1: native parity fuzz (both backends)"
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+            tests/test_native_pipeline.py tests/test_native.py -q \
+            -p no:cacheprovider -p no:randomly || {
+        rc=$?
+        echo "tier1: native parity fuzz FAILED (rc=$rc)" >&2
+        exit "$rc"
+    }
+    timeout -k 10 300 env JAX_PLATFORMS=cpu CHANAMQ_NATIVE=0 python -m pytest \
+            tests/test_frame.py tests/test_golden_wire.py -q \
+            -p no:cacheprovider -p no:randomly || {
+        rc=$?
+        echo "tier1: pure-Python twin (CHANAMQ_NATIVE=0) FAILED (rc=$rc)" >&2
+        exit "$rc"
+    }
+else
+    echo "tier1: no C++ compiler — skipping native rebuild gate"
+fi
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 if [ "$rc" -ne 0 ]; then
     echo "tier1: pytest FAILED (rc=$rc)" >&2

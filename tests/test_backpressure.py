@@ -182,7 +182,7 @@ async def test_frozen_consumer_bounds_write_buffer():
         if i % 500 == 499:
             await chp.wait_unconfirmed_below(1)
     await chp.wait_unconfirmed_below(1)
-    bufs = [len(cn._out) for cn in srv._connections]
+    bufs = [cn._out_bytes + cn._egress_bytes for cn in srv._connections]
     queue = broker.vhosts["/"].queues["stall_q"]
     assert max(bufs) < 6 * 1024 * 1024, f"write buffer unbounded: {bufs}"
     assert len(queue.messages) > 0
@@ -190,7 +190,8 @@ async def test_frozen_consumer_bounds_write_buffer():
     c_cons.reader._transport.resume_reading()
     await wait_for(
         lambda: not queue.messages
-        and all(len(cn._out) == 0 for cn in srv._connections), timeout=30)
+        and all(cn._out_bytes + cn._egress_bytes == 0
+                for cn in srv._connections), timeout=30)
     await c_prod.close()
     await c_cons.close()
     await srv.stop()
