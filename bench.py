@@ -86,7 +86,7 @@ PACED_PERSISTENT_SPEC = "paced_persistent_latency_1p1c"
 
 async def producer_main(
     port: int, persistent: bool, seconds: float, rate: int = 0,
-    keys: "list[str] | None" = None,
+    keys: "list[str] | None" = None, shape: str = "burst",
 ) -> None:
     from chanamq_tpu.amqp.properties import BasicProperties
     from chanamq_tpu.client import AMQPClient
@@ -101,8 +101,11 @@ async def producer_main(
     deadline = time.perf_counter() + seconds
     published = 0
     if rate > 0:
-        # fixed-rate pacing in 10 ms micro-bursts (PerfTest --rate shape)
-        burst = max(1, rate // 100)
+        # fixed-rate pacing: 10 ms micro-bursts (PerfTest --rate shape) by
+        # default, or strictly per-message ("smooth") — the burst shape
+        # queues up to rate/100 messages at each tick, so its measured p99
+        # has a ~10 ms floor that buries sub-ms broker latency
+        burst = 1 if shape == "smooth" else max(1, rate // 100)
         next_t = time.perf_counter()
         while time.perf_counter() < deadline:
             for _ in range(burst):
@@ -411,7 +414,8 @@ def regress_evaluate(current: dict, base: dict,
 
 
 def run_spec(name: str, rate: int = 0,
-             extra_env: "dict | None" = None) -> dict:
+             extra_env: "dict | None" = None,
+             shape: str = "burst") -> dict:
     persistent = False
     exchange_type = "direct"
     queues = None  # default bench_q/bench
@@ -480,7 +484,8 @@ def run_spec(name: str, rate: int = 0,
             children.append(subprocess.Popen(
                 [sys.executable, __file__, "--role", "producer",
                  "--port", str(port), "--persistent", str(int(persistent)),
-                 "--seconds", str(BENCH_SECONDS), "--rate", str(rate)]
+                 "--seconds", str(BENCH_SECONDS), "--rate", str(rate),
+                 "--shape", shape]
                 + producer_args,
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
         outs, errs = _reap_children(children, consumers, BENCH_SECONDS + 60)
@@ -1989,12 +1994,14 @@ def main() -> None:
         parser.add_argument("--rate", type=int, default=0)
         parser.add_argument("--queue", default="bench_q")
         parser.add_argument("--keys", default="")
+        parser.add_argument("--shape", default="burst",
+                            choices=("burst", "smooth"))
         args = parser.parse_args()
         if args.role == "producer":
             keys = [k for k in args.keys.split(",") if k] or None
             asyncio.run(producer_main(
                 args.port, bool(args.persistent), args.seconds, args.rate,
-                keys))
+                keys, args.shape))
         else:
             asyncio.run(consumer_main(
                 args.port, bool(args.auto_ack), args.seconds, args.queue))
@@ -2145,6 +2152,56 @@ def main() -> None:
         run_overhead(
             "semantics_overhead_pct",
             [("off", {"CHANAMQ_SEMANTICS_ENABLED": "false"}), ("on", None)],
+            budget_pct=-2.0)
+        return
+
+    if "--federation" in sys.argv:
+        # two-cluster federation soak: stream segments ship to a mirror
+        # cluster, the link is severed mid-stream, the consumer group
+        # fails over to the mirror and resumes from its mirrored cursor,
+        # the link heals and the backlog drains — zero confirmed loss,
+        # contiguous resume, no post-settle duplicates, and a
+        # byte-identical same-seed link transition log
+        seed = 42
+        if "--seed" in sys.argv:
+            seed = int(sys.argv[sys.argv.index("--seed") + 1])
+        from chanamq_tpu.chaos.soak import run_federation_soak
+
+        t0 = time.perf_counter()
+        try:
+            result = asyncio.run(asyncio.wait_for(
+                run_federation_soak(seed), timeout=240))
+        except Exception as exc:
+            result = {"seed": seed,
+                      "violations": [f"{type(exc).__name__}: {exc}"]}
+        elapsed = time.perf_counter() - t0
+        print(f"# federation_soak: {result}", file=sys.stderr)
+        run = result.get("run") or {}
+        # both same-seed runs ship the full stream twice over the link
+        shipped = 2 * (run.get("records") or 0)
+        print(json.dumps({
+            "metric": "federation_soak_violations",
+            "value": len(result.get("violations", [])),
+            "unit": "violations",
+            "vs_baseline": None,
+            "seed": seed,
+            "deterministic": result.get("deterministic"),
+            "mirrored_records_per_s": (
+                round(shipped / elapsed, 1) if elapsed > 0 else None),
+            "federation_soak": {k: v for k, v in result.items()},
+        }))
+        if result.get("violations"):
+            sys.exit(1)  # the tier-1 smoke must fail loudly
+        return
+
+    if "--federation-overhead" in sys.argv:
+        # master-switch cost: federation enabled (listener up, zero links)
+        # vs the default-off broker on the standard transient scenario;
+        # an idle federation endpoint may cost at most 2%
+        run_overhead(
+            "federation_overhead_pct",
+            [("off", None),
+             ("on", {"CHANAMQ_FEDERATION_ENABLED": "true"})],
             budget_pct=-2.0)
         return
 
@@ -2798,10 +2855,21 @@ def main() -> None:
         results[name] = run_spec(name)
         print(f"# {name}: {results[name]}", file=sys.stderr)
     headline = results[names[0]]
+    paced_shape = "burst"
+    if "--paced-shape" in sys.argv:
+        paced_shape = sys.argv[sys.argv.index("--paced-shape") + 1]
+        if paced_shape not in ("burst", "smooth"):
+            print(f"# unknown --paced-shape {paced_shape!r}; using burst",
+                  file=sys.stderr)
+            paced_shape = "burst"
     if which != "a":
         # paced latency runs at ~25% of the measured PUBLISHED throughput
         # (not delivered: a fan-out headline's delivered rate counts every
-        # copy and would oversaturate the 1p1c spec), or the env override
+        # copy and would oversaturate the 1p1c spec), or the env override.
+        # --paced-shape smooth paces per message instead of 10 ms
+        # micro-bursts and records under its own scenario name: the burst
+        # shape's queueing delay floors the measured p99 near 10 ms, so
+        # sub-ms broker latency is only visible in the smooth series.
         for paced_name, env_key, base in (
                 (PACED_SPEC, "BENCH_PACED_RATE", headline),
                 (PACED_PERSISTENT_SPEC, "BENCH_PACED_PERSISTENT_RATE",
@@ -2815,9 +2883,12 @@ def main() -> None:
                 print(f"# {paced_name}: skipped (no base throughput and "
                       f"no {env_key})", file=sys.stderr)
                 continue
-            results[paced_name] = run_spec(paced_name, rate=rate)
-            results[paced_name]["rate"] = rate
-            print(f"# {paced_name}: {results[paced_name]}", file=sys.stderr)
+            key = (paced_name if paced_shape == "burst"
+                   else f"{paced_name}_smooth")
+            results[key] = run_spec(paced_name, rate=rate,
+                                    shape=paced_shape)
+            results[key]["rate"] = rate
+            print(f"# {key}: {results[key]}", file=sys.stderr)
     cluster = None
     if which == "all":
         cluster = run_cluster_spec()

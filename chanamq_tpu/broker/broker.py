@@ -229,6 +229,10 @@ class Broker:
         # chana.mq.tenant.enabled — every enforcement seam is one
         # attribute load + identity check when off
         self.tenancy: Optional[Any] = None
+        # cross-cluster federation (chanamq_tpu/federation/): None unless
+        # chana.mq.federation.enabled — the seal/commit/DLX/Tx hooks are
+        # one attribute load + identity check when off
+        self.federation: Optional[Any] = None
         self.blocked = False
         self.blocked_reason = ""  # wire-visible cause (Connection.Blocked)
         self._mem_over = False    # resident_bytes above the RAM watermark
@@ -1429,6 +1433,14 @@ class Broker:
                 if sm is None:
                     return  # blob already gone: nothing to forward
                 body = sm.body
+            if self.federation is not None:
+                # remote-owner DLX routing: a federated dead-letter
+                # exchange receives the copy on the far cluster too —
+                # staged before the local publish, which may legitimately
+                # NOT_FOUND when the exchange exists only remotely
+                self.federation.on_dead_letter(
+                    vhost_name, exchange, routing_key,
+                    props.encode_header(len(body)), body)
             await self.publish(vhost_name, exchange, routing_key, props, body)
         except BrokerError as exc:
             log.warning("dead-letter publish to '%s' dropped: %s",

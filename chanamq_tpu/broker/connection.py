@@ -2560,6 +2560,8 @@ class AMQPConnection:
                   and getattr(store, "tx_begin", None) is not None)
         marks: list[tuple[int, int]] = []
         touched: list = []
+        federation = self.broker.federation
+        staged_federated: list = []
         mark0 = 0
         if scoped:
             mark0 = store.mark()
@@ -2594,6 +2596,15 @@ class AMQPConnection:
                         self._remote_strict = True
                     self._publish_aftermath(
                         channel, pub, props, routed, deliverable, None)
+                    if federation is not None:
+                        # federated Tx: stage the publish for the link
+                        # boundary; the whole staging ships as ONE batch
+                        # only after this commit succeeds locally
+                        staged_federated.append((
+                            method.exchange, method.routing_key,
+                            pub.header_raw
+                            or props.encode_header(len(pub.body)),
+                            pub.body))
                 else:
                     kind, delivery = op
                     channel.tx_release_held(delivery)
@@ -2653,5 +2664,10 @@ class AMQPConnection:
                 "vhost": self.vhost_name, "channel": channel.id,
                 "ops": len(ops), "atomic": scoped,
             }, vhost_name=self.vhost_name)
+        if federation is not None and staged_federated:
+            # commit succeeded locally: hand each link its slice as one
+            # all-or-nothing batch (links with no matching exchange see
+            # nothing; a down link stages and ships after heal)
+            federation.stage_tx_batch(self.vhost_name, staged_federated)
         await self._settle_remote_failures()
         await store.flush(marks)

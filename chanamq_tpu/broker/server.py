@@ -408,6 +408,7 @@ async def run_node(config) -> None:
     forecaster = None
     telemetry = None
     control = None
+    federation = None
     started = False
     stop_event = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -522,6 +523,16 @@ async def run_node(config) -> None:
             # seeds): don't open listeners just to tear clients down
             return
         await server.start_listeners()
+        if config.bool("chana.mq.federation.enabled"):
+            # cross-cluster federation (federation/): the fed.* listener
+            # (mirror side) plus one shipping link per configured remote.
+            # Boots after the listeners so an inbound fed.resume can
+            # declare its mirror streams on a fully-started broker; with
+            # no links configured the only steady-state cost is the idle
+            # listener and `broker.federation is None` checks staying hot
+            from ..federation import enable_from_config as federation_enable
+
+            federation = await federation_enable(config, server.broker)
         if config.bool("chana.mq.telemetry.enabled"):
             # per-entity telemetry + health + alerts (telemetry/): started
             # after the cluster layer so the first tick already sees the
@@ -554,6 +565,8 @@ async def run_node(config) -> None:
                 repl_lag_ready=config.int("chana.mq.telemetry.ready-repl-lag"),
                 store_error_window=config.int(
                     "chana.mq.telemetry.store-error-window"),
+                federation_lag_records=config.int(
+                    "chana.mq.slo.federation-lag-records"),
             )
             if config.bool("chana.mq.slo.enabled"):
                 # burn-rate SLOs ride the telemetry tick (slo/): specs
@@ -665,6 +678,8 @@ async def run_node(config) -> None:
             await telemetry.stop()
         if forecaster:
             await forecaster.stop()
+        if federation:
+            await federation.stop()
         if cluster:
             await cluster.stop()
         if started:

@@ -2965,3 +2965,266 @@ async def run_semantics_soak(seed: int) -> dict:
         and normalize(dlx1) == normalize(dlx2),
         "violations": violations,
     }
+
+
+# ---------------------------------------------------------------------------
+# Federation soak (PR 19): two clusters, a severed link, a failed-over
+# consumer, a heal — zero confirmed loss, contiguous cursor resume, no
+# post-settle duplicates, and a seed-deterministic link transition log.
+# ---------------------------------------------------------------------------
+
+def _federation_sever_plan(seed: int) -> FaultPlan:
+    """Every ship and every reconnect attempt fails while installed: a
+    hard link sever at the federation seams (transport untouched, so the
+    intra-broker clients keep working)."""
+    return FaultPlan(seed, [
+        FaultRule(name="sever-ship", kind="error", sites=["fed.ship"]),
+        FaultRule(name="sever-connect", kind="error",
+                  sites=["fed.connect"]),
+    ])
+
+
+async def _federation_run(seed: int) -> dict:
+    """One seeded two-cluster run. Cluster A owns stream ``fq`` and a
+    federation link to cluster B; a consumer on A commits a cursor, the
+    link is severed mid-stream, the consumer fails over to B's mirror and
+    resumes from the mirrored cursor, the link heals and the backlog
+    ships. Returns a wall-clock-free report the determinism gate can
+    compare byte-for-byte across same-seed runs."""
+    import random as _random
+    from zlib import crc32
+
+    from ..amqp.properties import BasicProperties
+    from ..broker.server import BrokerServer
+    from ..client.client import AMQPClient
+    from ..federation import FederationService
+    from ..store.memory import MemoryStore
+
+    rng = _random.Random((seed * 1_000_003) ^ crc32(b"federation"))
+    violations: list[str] = []
+    phase1 = 40 + rng.randrange(20)   # records before the sever
+    phase2 = 30 + rng.randrange(20)   # records published while severed
+    total = phase1 + phase2
+    commit_k = phase1 // 2            # cursor committed through this index
+    qname = "fq"
+    cursor = "fed-cursor"
+
+    async def eventually(predicate, timeout=15.0, what="condition"):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while not predicate():
+            if asyncio.get_event_loop().time() > deadline:
+                violations.append(f"timed out waiting for {what}")
+                return False
+            await asyncio.sleep(0.02)
+        return True
+
+    # an empty seeded plan keeps chaos.backoff_rng() deterministic for
+    # the whole run, including the healed phase
+    install(FaultPlan(seed, []))
+    b_srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                         store=MemoryStore())
+    await b_srv.start()
+    fed_b = FederationService(b_srv.broker, node_name="cluster-b", port=0)
+    await fed_b.start()
+    a_srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                         store=MemoryStore())
+    await a_srv.start()
+    fed_a = FederationService(
+        a_srv.broker, node_name="cluster-a", port=0,
+        retry_s=0.05, idle_s=0.05,
+        links=[{"name": "to-b", "host": "127.0.0.1", "port": fed_b.port,
+                "queues": [qname], "window": 4}])
+    await fed_a.start()
+    link = fed_a.links[0]
+    report: dict = {}
+    try:
+        conn = await AMQPClient.connect("127.0.0.1", a_srv.bound_port)
+        pch = await conn.channel()
+        await pch.confirm_select()
+        # small segments so the run seals (and ships) many of them
+        await pch.queue_declare(qname, durable=True, arguments={
+            "x-queue-type": "stream",
+            "x-stream-max-segment-size-bytes": 256})
+        props = BasicProperties(delivery_mode=2)
+        for i in range(phase1):
+            pch.basic_publish(f"f{i:06d}".encode(), routing_key=qname,
+                              properties=props)
+        await pch.wait_unconfirmed_below(1, timeout=30)
+
+        # consume on A and commit the cursor through commit_k
+        got: list = []
+        half_done = asyncio.Event()
+        ch1 = await conn.channel()
+        await ch1.basic_qos(prefetch_count=total + 8)
+
+        def on_a(msg):
+            got.append((msg.delivery_tag, bytes(msg.body).decode()))
+            if len(got) == commit_k + 1:
+                half_done.set()
+
+        await ch1.basic_consume(qname, on_a, consumer_tag=cursor,
+                                arguments={"x-stream-offset": "first"})
+        await asyncio.wait_for(half_done.wait(), 15)
+        ch1.basic_ack(got[commit_k][0], multiple=True)
+        await asyncio.sleep(0.2)  # let the coalesced commit flush
+        await ch1.basic_cancel(cursor)
+
+        a_queue = a_srv.broker.get_queue("/", qname)
+        b_queue_next = lambda: (  # noqa: E731
+            b_srv.broker.vhosts["/"].queues.get(qname).next_offset
+            if b_srv.broker.vhosts["/"].queues.get(qname) else 0)
+        # quiesce: every sealed segment shipped, cursor mirrored — the
+        # sever point is then a pure function of the seed, not of timing
+        sealed_tail = a_queue._active_base
+        await eventually(lambda: b_queue_next() >= sealed_tail,
+                         what="pre-sever ship quiesce")
+        # stream offsets are 1-based: body f{i} lives at offset i+1,
+        # so acking through got[commit_k] commits offset commit_k + 1
+        await eventually(
+            lambda: (b_srv.broker.vhosts["/"].queues.get(qname) is not None
+                     and b_srv.broker.vhosts["/"].queues[qname]
+                     .committed.get(cursor) == commit_k + 1),
+            what="cursor mirror")
+        pre_sever_next = b_queue_next()
+
+        # -- sever the link and keep publishing ----------------------------
+        install(_federation_sever_plan(seed))
+        for i in range(phase1, total):
+            pch.basic_publish(f"f{i:06d}".encode(), routing_key=qname,
+                              properties=props)
+        await pch.wait_unconfirmed_below(1, timeout=30)
+        link.wake()
+        await eventually(lambda: link.state == "down", what="link down")
+        if b_queue_next() != pre_sever_next:
+            violations.append(
+                f"severed link still shipped: mirror next "
+                f"{b_queue_next()} != {pre_sever_next}")
+
+        # -- fail the consumer group over to the mirror --------------------
+        b_conn = await AMQPClient.connect("127.0.0.1", b_srv.bound_port)
+        b_ch = await b_conn.channel()
+        await b_ch.basic_qos(prefetch_count=total + 8)
+        failover: list = []
+        failover_caught_up = asyncio.Event()
+
+        def on_b(msg):
+            failover.append(bytes(msg.body).decode())
+            if len(failover) >= total - commit_k - 1:
+                failover_caught_up.set()
+
+        await b_ch.basic_consume(qname, on_b, consumer_tag=cursor,
+                                 arguments={"x-stream-offset": "next"})
+        # the mirror can only serve what shipped before the sever:
+        # offsets commit_k + 2 .. pre_sever_next - 1
+        await eventually(
+            lambda: len(failover) >= pre_sever_next - commit_k - 2,
+            what="failover consumer catch-up to severed tail")
+        resumed_at = failover[0] if failover else None
+        if resumed_at != f"f{commit_k + 1:06d}":
+            violations.append(
+                f"failover did not resume at committed+1: got {resumed_at}")
+
+        # -- heal: backlog ships, mirror converges on the full stream ------
+        install(FaultPlan(seed, []))
+        link.wake()
+        await eventually(lambda: link.state == "up", what="link heal")
+        # seal A's active segment so the tail records become shippable
+        if a_queue._active:
+            a_queue._seal_active()
+        link.wake()
+        await eventually(lambda: b_queue_next() >= total,
+                         what="post-heal backlog ship")
+        try:
+            await asyncio.wait_for(failover_caught_up.wait(), 15)
+        except asyncio.TimeoutError:
+            violations.append(
+                f"failover consumer saw {len(failover)}/{total - commit_k - 1}"
+                " records after heal")
+
+        # -- invariants -----------------------------------------------------
+        expected = [f"f{i:06d}" for i in range(commit_k + 1, total)]
+        if failover[:len(expected)] != expected:
+            violations.append(
+                f"failover delivery not contiguous: got {failover[:3]}.. "
+                f"expected {expected[:3]}..")
+        settle_len = len(failover)
+        await asyncio.sleep(0.4)  # observation window
+        if len(failover) != settle_len:
+            violations.append(
+                f"{len(failover) - settle_len} deliveries after settle")
+        dupes = {b for b in failover if failover.count(b) > 1}
+        if dupes:
+            violations.append(f"duplicate failover deliveries: "
+                              f"{sorted(dupes)[:3]}")
+
+        # zero confirmed loss: a fresh reader of the mirror sees every
+        # confirmed record, in order
+        mirror: list = []
+        mirror_done = asyncio.Event()
+        m_ch = await b_conn.channel()
+        await m_ch.basic_qos(prefetch_count=total + 8)
+
+        def on_mirror(msg):
+            mirror.append(bytes(msg.body).decode())
+            if len(mirror) >= total:
+                mirror_done.set()
+
+        await m_ch.basic_consume(qname, on_mirror, consumer_tag="fed-audit",
+                                 arguments={"x-stream-offset": "first"})
+        try:
+            await asyncio.wait_for(mirror_done.wait(), 15)
+        except asyncio.TimeoutError:
+            pass
+        if mirror != [f"f{i:06d}" for i in range(total)]:
+            violations.append(
+                f"mirror lost confirmed records: {len(mirror)}/{total}")
+
+        metrics = a_srv.broker.metrics
+        report = {
+            "records": total,
+            "committed_through": commit_k,
+            "pre_sever_next": pre_sever_next,
+            "resumed_at": resumed_at,
+            "mirror_records": len(mirror),
+            "segments_shipped": metrics.federation_segments_shipped,
+            "resumes": metrics.federation_resumes,
+            "transitions": fed_a.transition_log(),
+        }
+        await b_conn.close()
+        await conn.close()
+    finally:
+        await fed_a.stop()
+        await a_srv.stop()
+        await fed_b.stop()
+        await b_srv.stop()
+        clear()
+    report["violations"] = violations
+    return report
+
+
+async def run_federation_soak(seed: int) -> dict:
+    """Federation chaos soak: the seeded sever/heal run executes TWICE
+    and the normalized reports (violations aside) must serialize
+    byte-identically — the publish mix, the sever point and the link
+    transition log are all pure functions of the seed."""
+    import json as _json
+
+    one = await _federation_run(seed)
+    two = await _federation_run(seed)
+    violations = list(one["violations"])
+    violations.extend(f"repeat: {v}" for v in two["violations"])
+
+    def normalize(run: dict) -> str:
+        return _json.dumps(
+            {k: v for k, v in run.items() if k != "violations"},
+            sort_keys=True)
+
+    deterministic = normalize(one) == normalize(two)
+    if not deterministic:
+        violations.append("same-seed federation runs are not byte-identical")
+    return {
+        "seed": seed,
+        "run": one,
+        "deterministic": deterministic,
+        "violations": violations,
+    }

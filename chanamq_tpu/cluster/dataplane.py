@@ -437,10 +437,13 @@ class DataStream:
             writer.writelines(frame)
             await writer.drain()
             try:
-                return await asyncio.wait_for(fut, timeout_s or self.timeout_s)
+                result = await asyncio.wait_for(
+                    fut, timeout_s or self.timeout_s)
             except asyncio.TimeoutError:
                 self._waiters.pop(corr_id, None)
                 raise RpcTimeout(f"data:{method_id}") from None
+            self._backoff.note_clean()
+            return result
         finally:
             self.inflight -= 1
             self._window.release()

@@ -830,8 +830,7 @@ class ClusterNode:
             await asyncio.sleep(self._anti_entropy_s)
             if self.membership is None:
                 continue
-            peers = [n for n in self.membership.alive_members()
-                     if n != self.name]
+            peers = self._anti_entropy_peers()
             if not peers:
                 continue
             peer = peers[peer_idx % len(peers)]
@@ -842,6 +841,23 @@ class ClusterNode:
                 await self._merge_snapshot(snapshot, peer)
             except (RpcError, OSError) as exc:
                 log.debug("anti-entropy pull from %s failed: %r", peer, exc)
+
+    def _anti_entropy_peers(self) -> list[str]:
+        """Alive peers worth pulling a snapshot from. Liveness and
+        lifecycle converge independently, so a departed member can gossip
+        as alive for a while after LEFT lands — pulling its snapshot
+        would resurrect metas it is busy forgetting."""
+        from .membership import LEFT
+
+        peers = []
+        for n in self.membership.alive_members():
+            if n == self.name:
+                continue
+            if self.membership.lifecycle_of(n) == LEFT:
+                self.broker.metrics.lifecycle_left_peer_skipped += 1
+                continue
+            peers.append(n)
+        return peers
 
     async def _merge_snapshot(self, snapshot: dict, peer: str) -> None:
         """Add-only snapshot merge: fill in queue metas, exchanges and

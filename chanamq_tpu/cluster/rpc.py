@@ -413,16 +413,28 @@ class ReconnectBackoff:
     capped at max_s — so N clients dropped by the same peer failure spread
     their reconnects instead of retrying in lockstep. When a seeded chaos
     plan is active the draw comes from the plan's RNG, keeping chaos runs
-    reproducible."""
+    reproducible.
 
-    __slots__ = ("base_s", "max_s", "failures", "_delay_s", "_retry_at")
+    A successful dial only clears the retry deadline; the accumulated
+    delay survives until the peer has answered `clean_reset_calls`
+    consecutive calls. A flapping peer that accepts connects and then
+    drops them used to reset the delay to zero on every dial, turning
+    backoff into a tight reconnect loop."""
 
-    def __init__(self, base_s: float = 0.1, max_s: float = 5.0) -> None:
+    __slots__ = ("base_s", "max_s", "failures", "clean_reset_calls",
+                 "_delay_s", "_retry_at", "_clean_calls")
+
+    def __init__(
+        self, base_s: float = 0.1, max_s: float = 5.0,
+        clean_reset_calls: int = 8,
+    ) -> None:
         self.base_s = base_s
         self.max_s = max_s
+        self.clean_reset_calls = clean_reset_calls
         self.failures = 0  # consecutive failed connects since last success
         self._delay_s = 0.0
         self._retry_at = 0.0
+        self._clean_calls = 0  # completed calls since the last failure
 
     def check(self) -> None:
         if self._delay_s and asyncio.get_event_loop().time() < self._retry_at:
@@ -436,11 +448,23 @@ class ReconnectBackoff:
             self.max_s,
             rng.uniform(self.base_s, max(self.base_s, prev * 3)))
         self.failures += 1
+        self._clean_calls = 0
         self._retry_at = asyncio.get_event_loop().time() + self._delay_s
 
     def succeeded(self) -> None:
-        self._delay_s = 0.0
-        self.failures = 0
+        # dial success is not proven health: keep the delay armed so a
+        # peer that accepts and immediately drops still backs off
+        self._retry_at = 0.0
+
+    def note_clean(self) -> None:
+        """A call round-tripped; after enough of them, forgive history."""
+        if not self.failures and not self._delay_s:
+            return
+        self._clean_calls += 1
+        if self._clean_calls >= self.clean_reset_calls:
+            self._delay_s = 0.0
+            self.failures = 0
+            self._clean_calls = 0
 
     def state(self) -> dict:
         """Current backoff posture, surfaced by /admin/cluster."""
@@ -595,10 +619,12 @@ class RpcClient:
         writer.write(_encode(corr_id, KIND_REQUEST, method, payload or {}))
         await writer.drain()
         try:
-            return await asyncio.wait_for(fut, timeout_s or self.timeout_s)
+            result = await asyncio.wait_for(fut, timeout_s or self.timeout_s)
         except asyncio.TimeoutError:
             self._waiters.pop(corr_id, None)
             raise RpcTimeout(method) from None
+        self._backoff.note_clean()
+        return result
 
     async def send_event(self, method: str, payload: Optional[dict] = None) -> None:
         """Fire-and-forget (the reference's `tell`)."""
@@ -611,6 +637,7 @@ class RpcClient:
                 return  # fire-and-forget: any transport fault = silent loss
         writer.write(_encode(0, KIND_EVENT, method, payload or {}))
         await writer.drain()
+        self._backoff.note_clean()
 
     async def close(self) -> None:
         self.closed = True

@@ -413,6 +413,20 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.slo.fast-burn": 14.4,         # 5m/1h pair burn threshold
     "chana.mq.slo.slow-burn": 6.0,          # 6h/3d pair burn threshold
     "chana.mq.slo.specs": None,
+    # a federation-lag SLI tick is good while every link's record lag is
+    # at or under this bound (slo/__init__.py samples it per link)
+    "chana.mq.slo.federation-lag-records": 1000,
+    # cross-cluster federation (chanamq_tpu/federation/): a dedicated
+    # listener serves the fed.* handlers (mirror side); links is a JSON
+    # array of {name, host, port, vhost, queues, exchanges, window}
+    # specs naming the remotes this node ships to (shipper side).
+    "chana.mq.federation.enabled": False,
+    "chana.mq.federation.interface": "127.0.0.1",
+    "chana.mq.federation.port": 0,          # 0 = ephemeral (tests/bench)
+    "chana.mq.federation.links": None,
+    "chana.mq.federation.window": 4,        # per-link in-flight sends
+    "chana.mq.federation.retry": "500ms",   # down-link reconnect pace
+    "chana.mq.federation.idle-tick": "200ms",  # pump tick with no wake
 }
 
 _DURATION_RE = re.compile(r"^\s*([0-9.]+)\s*(ms|s|m|h|d)?\s*$")

@@ -242,6 +242,9 @@ class AdminServer:
             return ("POST", lambda: self._slo_configure(body))
         if rest == ["events"]:
             return ("GET", self._events_status)
+        if rest == ["federation"]:
+            return ({"GET": self._federation,
+                     "POST": lambda: self._federation_post(body)}, None)
         if rest == ["tenants"]:
             return ({"GET": self._tenants,
                      "POST": lambda: self._tenant_put(body)}, None)
@@ -575,6 +578,43 @@ class AdminServer:
                 "vhost": fh.vhost, "queue_filter": fh.queue_filter})
         return out
 
+    # -- federation (chanamq_tpu/federation/) ------------------------------
+
+    def _federation_svc(self):
+        svc = getattr(self.broker, "federation", None)
+        if svc is None:
+            raise AdminError(
+                "409 Conflict",
+                "federation disabled: boot with chana.mq.federation.enabled")
+        return svc
+
+    def _federation(self) -> dict:
+        """Per-link state, lag, outbox depth and the recent event log."""
+        return self._federation_svc().stats()
+
+    def _federation_post(self, body: bytes) -> dict:
+        """Operator nudges: {"action": "wake"[, "link": name]} forces an
+        immediate pump instead of waiting out the idle tick (the runbook's
+        first move after healing a severed link)."""
+        svc = self._federation_svc()
+        try:
+            req = json.loads(body or b"{}")
+        except ValueError as exc:
+            raise AdminError("400 Bad Request", f"bad json: {exc}")
+        action = req.get("action")
+        if action != "wake":
+            raise AdminError("400 Bad Request",
+                             'supported actions: "wake"')
+        target = req.get("link")
+        woke = []
+        for link in svc.links:
+            if target is None or link.name == target:
+                link.wake()
+                woke.append(link.name)
+        if target is not None and not woke:
+            raise AdminError("404 Not Found", f"no link {target!r}")
+        return {"ok": True, "woke": woke}
+
     # -- message tracing (chanamq_tpu/trace/) ------------------------------
 
     def _traces(self) -> dict:
@@ -880,6 +920,19 @@ class AdminServer:
                     out.append(
                         f"chanamq_stream_cursor_lag{clabels} "
                         f"{queue.cursor_lag(cursor)}")
+        federation = getattr(self.broker, "federation", None)
+        if federation is not None and federation.links:
+            # per-link mirror lag in records plus an up/down gauge; the
+            # aggregate federation_* counters ride the plain snapshot above
+            out.append("# TYPE chanamq_federation_link_lag gauge")
+            out.append("# TYPE chanamq_federation_link_up gauge")
+            for link in federation.links:
+                labels = f'{{link="{self._prom_label(link.name)}"}}'
+                out.append(
+                    f"chanamq_federation_link_lag{labels} {link.total_lag()}")
+                out.append(
+                    f"chanamq_federation_link_up{labels} "
+                    f"{int(link.state == 'up')}")
         telemetry = getattr(self.broker, "telemetry", None)
         if telemetry is not None and telemetry.engine.firing:
             # one series per firing alert instance, value 1 while firing;

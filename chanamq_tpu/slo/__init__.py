@@ -33,9 +33,11 @@ class SLISampler:
     observations only), so one slow burst cannot poison the p99 forever.
     """
 
-    def __init__(self, broker, latency_threshold_ms: float = 250.0) -> None:
+    def __init__(self, broker, latency_threshold_ms: float = 250.0,
+                 federation_lag_records: int = 1000) -> None:
         self.broker = broker
         self.latency_threshold_ms = latency_threshold_ms
+        self.federation_lag_records = federation_lag_records
         self._prev: dict[str, float] = {}
         self._prev_buckets: dict[str, list[int]] = {}
 
@@ -105,6 +107,22 @@ class SLISampler:
                 if tenant.latency_hist is not None:
                     samples[f"delivery-latency@{name}"] = (
                         self._latency_sample(tenant.latency_hist, name))
+        federation = getattr(self.broker, "federation", None)
+        if federation is not None:
+            # per-link streams reuse the tenant scoping machinery: a spec
+            # with tenant="<link-name>" reads "federation-lag@<link>"; the
+            # node-wide stream is judged on the worst link. Good iff the
+            # link is up and its record lag is within budget — a down link
+            # burns the budget even before the lag number catches up.
+            worst_bad = 0.0
+            for link in federation.links:
+                bad = (link.state != "up"
+                       or link.total_lag() > self.federation_lag_records)
+                samples[f"federation-lag@{link.name}"] = (
+                    (0.0, 1.0) if bad else (1.0, 0.0))
+                worst_bad = max(worst_bad, float(bad))
+            if federation.links:
+                samples["federation-lag"] = (1.0 - worst_bad, worst_bad)
         return samples
 
 

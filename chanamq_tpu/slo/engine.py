@@ -45,6 +45,7 @@ SLI_KINDS = (
     "delivery-success",   # good=deliveries, bad=dead-lettered+expired
     "readiness",          # one sample per tick: /admin/health ready?
     "delivery-latency",   # one sample per tick: delta p99 <= threshold?
+    "federation-lag",     # one sample per tick: link lag <= record budget?
 )
 
 

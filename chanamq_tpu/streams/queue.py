@@ -260,6 +260,11 @@ class StreamQueue(Queue):
         self._active_first_ts = None
         self._enforce_retention()
         self._evict_cache()
+        federation = self.broker.federation
+        if federation is not None:
+            # sealed segments are the federation shipping unit: wake any
+            # link mirroring this stream
+            federation.on_seal(self)
 
     def _enforce_retention(self, now: Optional[int] = None) -> None:
         """Truncate whole sealed segments from the head while over the
@@ -435,6 +440,11 @@ class StreamQueue(Queue):
             return
         self.committed[name] = offset
         self.broker.metrics.stream_cursor_commits += 1
+        federation = self.broker.federation
+        if federation is not None:
+            # mirror the commit so a failed-over consumer group resumes
+            # contiguously on the remote cluster (coalesced per link)
+            federation.on_cursor_commit(self, name, offset)
         if self.durable:
             self._cursor_dirty.add(name)
             if not self._cursor_flush_scheduled:

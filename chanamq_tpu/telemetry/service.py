@@ -66,6 +66,7 @@ class TelemetryService:
         repl_lag_ready: int = 10000,
         store_error_window: int = 30,
         slo: Optional[SLOEngine] = None,
+        federation_lag_records: int = 1000,
     ) -> None:
         self.broker = broker
         self.interval_s = interval_s
@@ -75,6 +76,7 @@ class TelemetryService:
         self.engine = AlertEngine(
             rules if rules is not None else default_rules())
         self.alerts_enabled = alerts_enabled
+        self.federation_lag_records = federation_lag_records
         # SLO engine rides the same tick (None: feature off); the sampler
         # turns broker counters into per-tick (good, bad) SLI deltas
         self.slo: Optional[SLOEngine] = None
@@ -116,7 +118,9 @@ class TelemetryService:
             if spec.sli == "delivery-latency":
                 threshold = spec.threshold_ms
                 break
-        self.slo_sampler = SLISampler(self.broker, threshold)
+        self.slo_sampler = SLISampler(
+            self.broker, threshold,
+            federation_lag_records=self.federation_lag_records)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -274,6 +274,28 @@ class Metrics:
         self.dlx_expired = 0
         self.dlx_rejected = 0
         self.dlx_maxlen = 0
+        # federation (chanamq_tpu/federation/): sealed-segment shipping,
+        # mirrored cursor commits, DLX forwards and staged Tx batches
+        # across named links, both the shipping and the receiving side.
+        self.federation_segments_shipped = 0
+        self.federation_segment_bytes = 0
+        self.federation_segments_applied = 0
+        self.federation_duplicate_segments = 0
+        self.federation_crc_failures = 0
+        self.federation_ship_errors = 0
+        self.federation_resyncs = 0
+        self.federation_resumes = 0
+        self.federation_link_failures = 0
+        self.federation_cursors_shipped = 0
+        self.federation_cursors_mirrored = 0
+        self.federation_dlx_forwarded = 0
+        self.federation_tx_batches = 0
+        self.federation_tx_publishes = 0
+        self.federation_tx_applied = 0
+        self.federation_outbox_dropped = 0
+        # anti-entropy peers skipped because the lifecycle machine marked
+        # them LEFT (satellite of the federation PR)
+        self.lifecycle_left_peer_skipped = 0
         self.started_at = time.time()
 
     def published(self, nbytes: int) -> None:
@@ -456,6 +478,24 @@ class Metrics:
             "dlx_expired": self.dlx_expired,
             "dlx_rejected": self.dlx_rejected,
             "dlx_maxlen": self.dlx_maxlen,
+            "federation_segments_shipped": self.federation_segments_shipped,
+            "federation_segment_bytes": self.federation_segment_bytes,
+            "federation_segments_applied": self.federation_segments_applied,
+            "federation_duplicate_segments":
+                self.federation_duplicate_segments,
+            "federation_crc_failures": self.federation_crc_failures,
+            "federation_ship_errors": self.federation_ship_errors,
+            "federation_resyncs": self.federation_resyncs,
+            "federation_resumes": self.federation_resumes,
+            "federation_link_failures": self.federation_link_failures,
+            "federation_cursors_shipped": self.federation_cursors_shipped,
+            "federation_cursors_mirrored": self.federation_cursors_mirrored,
+            "federation_dlx_forwarded": self.federation_dlx_forwarded,
+            "federation_tx_batches": self.federation_tx_batches,
+            "federation_tx_publishes": self.federation_tx_publishes,
+            "federation_tx_applied": self.federation_tx_applied,
+            "federation_outbox_dropped": self.federation_outbox_dropped,
+            "lifecycle_left_peer_skipped": self.lifecycle_left_peer_skipped,
         }
         for key, hist in self.trace_stage_us.items():
             base = key[:-3] if key.endswith("_us") else key

@@ -360,6 +360,48 @@ done
     exit 1
 }
 
+echo "tier1: federation soak smoke (~15 s x2: sever mid-stream, failover, heal)"
+# two independent clusters joined by one link; the soak itself fails
+# (violation -> exit 1) on confirmed loss, a non-contiguous cursor
+# resume on the mirror, duplicate post-settle deliveries or a mirror
+# audit read that differs from the published set; the greps double-check
+# both same-seed repeats serialized byte-identically and violation-free
+# retried like the overhead gates: the soak's quiesce/failover waits are
+# deadline-based, so a CPU-steal burst on a shared box can time one out;
+# a real invariant violation fails every attempt
+ok=""
+for attempt in 1 2 3; do
+    if timeout -k 10 300 python bench.py --federation --seed 42 \
+            | tee /tmp/_t1_federation.json \
+            && grep -q '"deterministic": true' /tmp/_t1_federation.json \
+            && grep -q '"violations": \[\]' /tmp/_t1_federation.json; then
+        ok=1
+        break
+    fi
+    echo "tier1: federation soak attempt $attempt failed, retrying" >&2
+done
+[ -n "$ok" ] || {
+    echo "tier1: federation soak smoke FAILED (3 attempts) — cross-cluster invariant violation" >&2
+    exit 1
+}
+
+echo "tier1: federation overhead smoke (5 s x2: idle-link cost <= 2%)"
+# same retry rationale as the other overhead gates: federation is enabled
+# with zero links configured, so the per-publish cost is one attribute
+# test, but the off/on delta between independent runs is noise-prone
+ok=""
+for attempt in 1 2 3; do
+    if BENCH_SECONDS=5 timeout -k 10 120 python bench.py --federation-overhead; then
+        ok=1
+        break
+    fi
+    echo "tier1: federation overhead attempt $attempt over budget, retrying" >&2
+done
+[ -n "$ok" ] || {
+    echo "tier1: federation overhead smoke FAILED (3 attempts) — idle-link cost over budget" >&2
+    exit 1
+}
+
 echo "tier1: route microbench smoke (tensor router vs trie, parity gate)"
 # the bench itself fails (exit 1) on any kernel/oracle parity mismatch or
 # a broken key-shared fan-out; the grep double-checks both batched paths

@@ -175,9 +175,39 @@ async def test_backoff_jitter_envelope():
     with pytest.raises(RpcError):
         backoff.check()
     backoff.succeeded()
-    assert backoff.failures == 0
+    # dial success clears only the suppression window; the delay and
+    # failure count survive until enough clean calls round-trip
+    backoff.check()  # no longer suppressed...
+    assert backoff.failures == 11  # ...but history is not forgiven yet
+    for _ in range(backoff.clean_reset_calls):
+        backoff.note_clean()
     assert backoff.state() == {"delay_s": 0.0, "consecutive_failures": 0}
-    backoff.check()  # reset: no longer suppressed
+
+
+async def test_backoff_flapping_peer_keeps_delay():
+    """A peer that accepts the dial then drops every call must not get its
+    backoff zeroed by the dial alone — that was a tight reconnect loop."""
+    backoff = ReconnectBackoff(base_s=0.1, max_s=5.0, clean_reset_calls=4)
+    for _ in range(5):
+        backoff.failed()      # dial refused a few times
+    for _ in range(3):
+        backoff.succeeded()   # dial lands...
+        backoff.note_clean()  # ...one call round-trips...
+        backoff.failed()      # ...then the peer drops the connection
+    # the jittered delay may wander, but it is never zeroed mid-flap and
+    # the failure streak keeps compounding across the fake recoveries
+    assert backoff._delay_s >= backoff.base_s
+    assert backoff.failures == 8
+    # sustained health: a full run of clean calls resets to base
+    backoff.succeeded()
+    for _ in range(4):
+        backoff.note_clean()
+    assert backoff.state() == {"delay_s": 0.0, "consecutive_failures": 0}
+    # and a healthy-from-birth backoff never counts clean calls
+    fresh = ReconnectBackoff(clean_reset_calls=2)
+    for _ in range(10):
+        fresh.note_clean()
+    assert fresh._clean_calls == 0
 
 
 async def test_backoff_jitter_spreads_clients():

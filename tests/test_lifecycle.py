@@ -228,6 +228,10 @@ async def test_drain_gossips_lifecycle_and_leaves_placement(tmp_path):
             lambda: peer.cluster.membership.lifecycle_of(src.name) == LEFT)
         assert src.name not in peer.cluster.membership.placement_members()
         assert peer.cluster.membership.is_alive(src.name)
+        # anti-entropy must not pull snapshots from the departed member:
+        # liveness still says "alive", lifecycle says LEFT, lifecycle wins
+        assert src.name not in peer.cluster._anti_entropy_peers()
+        assert peer.broker.metrics.lifecycle_left_peer_skipped >= 1
     finally:
         for node in nodes:
             await node.stop()
