@@ -2664,10 +2664,14 @@ class AMQPConnection:
                 "vhost": self.vhost_name, "channel": channel.id,
                 "ops": len(ops), "atomic": scoped,
             }, vhost_name=self.vhost_name)
-        if federation is not None and staged_federated:
-            # commit succeeded locally: hand each link its slice as one
-            # all-or-nothing batch (links with no matching exchange see
-            # nothing; a down link stages and ships after heal)
-            federation.stage_tx_batch(self.vhost_name, staged_federated)
         await self._settle_remote_failures()
         await store.flush(marks)
+        if federation is not None and staged_federated:
+            # the commit is durable locally (the WAL flush above
+            # succeeded): only now hand each link its slice as one
+            # all-or-nothing batch — staging any earlier could ship a
+            # batch the local cluster never durably committed, leaving
+            # the clusters diverged with remote-only messages. Links
+            # with no matching exchange see nothing; a down link stages
+            # and ships after heal.
+            federation.stage_tx_batch(self.vhost_name, staged_federated)

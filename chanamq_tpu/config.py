@@ -427,6 +427,11 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.federation.window": 4,        # per-link in-flight sends
     "chana.mq.federation.retry": "500ms",   # down-link reconnect pace
     "chana.mq.federation.idle-tick": "200ms",  # pump tick with no wake
+    # shared secret on the fed listener; "" = open (trusted network).
+    # The listener sits outside the AMQP SASL/ACL path, so this token is
+    # its whole admission control. Links present it outbound too (a
+    # per-link `token` in the spec overrides for asymmetric pairs).
+    "chana.mq.federation.auth-token": "",
 }
 
 _DURATION_RE = re.compile(r"^\s*([0-9.]+)\s*(ms|s|m|h|d)?\s*$")

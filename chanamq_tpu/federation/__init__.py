@@ -88,6 +88,7 @@ async def enable_from_config(config, broker) -> Optional[FederationService]:
         retry_s=config.duration_s("chana.mq.federation.retry") or 0.5,
         idle_s=config.duration_s("chana.mq.federation.idle-tick") or 0.2,
         links=links,
+        auth_token=config.str("chana.mq.federation.auth-token") or "",
     )
     await service.start()
     return service

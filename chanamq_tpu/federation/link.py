@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import uuid
 import zlib
 from collections import deque
 from typing import TYPE_CHECKING, Optional
@@ -45,9 +46,10 @@ FED_SHIP = 1     # sealed segment ship
 FED_TX = 2       # staged Tx publish batch (all-or-nothing far side)
 FED_PUBLISH = 3  # single forwarded publish (DLX routing)
 
-# staged-work bound per link: a long outage drops the oldest forwards
-# rather than growing without bound (counted, and documented as at-most-
-# once for DLX/Tx forwarding across extended outages)
+# staged-work bound per link: a long outage drops staged forwards
+# rather than growing without bound (counted per kind, and documented as
+# at-most-once for DLX/Tx forwarding across extended outages). Single
+# DLX forwards shed before whole committed Tx batches — see _stage.
 _OUTBOX_MAX = 10_000
 
 
@@ -70,6 +72,15 @@ class FederationLink:
             str(e) for e in spec.get("exchanges", [])}
         self.window = max(1, int(spec.get("window", service.window)))
         self.retry_s = float(spec.get("retry_s", service.retry_s))
+        #: shared secret presented on every federation call (control and
+        #: data plane); must match the remote listener's ``auth_token``
+        self.token = str(spec.get("token", service.auth_token))
+        #: per-boot shipper incarnation: the receiver keys its Tx/publish
+        #: dedup high-water marks by (link, epoch), so a restarted
+        #: shipper whose in-memory sequences reset to 0 starts a fresh
+        #: dedup scope instead of having every batch swallowed as a
+        #: duplicate of the previous incarnation's sequence space
+        self.epoch = uuid.uuid4().hex[:16]
         self.rpc = RpcClient(self.host, self.port, timeout_s=10.0)
         self.data = DataStream(
             self.host, self.port, inflight=self.window, timeout_s=30.0,
@@ -85,6 +96,7 @@ class FederationLink:
         #: staged DLX forwards and Tx batches, drained in order
         self.outbox: deque = deque()
         self._tx_seq = 0
+        self._pub_seq = 0
         self._was_up = False
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
@@ -120,7 +132,10 @@ class FederationLink:
 
     def queue_publish(self, exchange: str, routing_key: str,
                       header_raw: bytes, body: bytes) -> None:
-        self._stage(("publish", exchange, routing_key, header_raw, body))
+        self._pub_seq += 1
+        self._stage(
+            ("publish", self._pub_seq, exchange, routing_key,
+             header_raw, body))
 
     def queue_tx(self, ops: list) -> None:
         self._tx_seq += 1
@@ -130,8 +145,19 @@ class FederationLink:
 
     def _stage(self, item: tuple) -> None:
         if len(self.outbox) >= _OUTBOX_MAX:
-            self.outbox.popleft()
-            self.service.metrics.federation_outbox_dropped += 1
+            # shed a single DLX forward before a whole committed Tx
+            # batch: the oldest publish goes first, a tx entry only when
+            # the outbox holds nothing else (counted per kind)
+            metrics = self.service.metrics
+            for idx, staged in enumerate(self.outbox):
+                if staged[0] == "publish":
+                    del self.outbox[idx]
+                    metrics.federation_outbox_dropped_publish += 1
+                    break
+            else:
+                self.outbox.popleft()
+                metrics.federation_outbox_dropped_tx += 1
+            metrics.federation_outbox_dropped += 1
         self.outbox.append(item)
         self._wake.set()
 
@@ -208,11 +234,13 @@ class FederationLink:
                 raise RpcError(fault.code or "chaos",
                                f"chaos[{fault.rule}]: {fault.message}")
         hello = await self.rpc.call(
-            "fed.hello", {"link": self.name, "node": self.service.node_name})
+            "fed.hello", {"link": self.name, "node": self.service.node_name,
+                          "epoch": self.epoch, "token": self.token})
         self.remote_node = str(hello.get("node", ""))
         for qname in self.queues:
             resume = await self.rpc.call("fed.resume", {
-                "link": self.name, "vhost": self.vhost, "queue": qname})
+                "link": self.name, "vhost": self.vhost, "queue": qname,
+                "token": self.token})
             self.remote_next[qname] = int(resume.get("next", 0))
         resumed = self._was_up
         self._was_up = True
@@ -314,6 +342,7 @@ class FederationLink:
                 raise RpcError("missing",
                                f"segment {seg.base_offset} unreadable")
         head = bytearray()
+        _put_ss(head, self.token)
         _put_ss(head, queue.vhost)
         _put_ss(head, queue.name)
         head += seg.base_offset.to_bytes(8, "big")
@@ -333,7 +362,7 @@ class FederationLink:
             try:
                 await self.rpc.call("fed.cursor", {
                     "link": self.name, "vhost": self.vhost, "queue": qname,
-                    "cursors": cursors})
+                    "cursors": cursors, "token": self.token})
             except BaseException:
                 # stays dirty; re-merge (a commit may have landed since)
                 merged = self.dirty_cursors.setdefault(qname, {})
@@ -347,8 +376,12 @@ class FederationLink:
         while self.outbox:
             item = self.outbox[0]
             if item[0] == "publish":
-                _, exchange, rkey, header, body = item
+                _, seq, exchange, rkey, header, body = item
                 buf = bytearray()
+                _put_ss(buf, self.token)
+                _put_ss(buf, self.name)
+                _put_ss(buf, self.epoch)
+                buf += seq.to_bytes(8, "big")
                 _put_ss(buf, self.vhost)
                 _put_ss(buf, exchange)
                 _put_ss(buf, rkey)
@@ -360,7 +393,9 @@ class FederationLink:
             else:
                 _, seq, ops = item
                 buf = bytearray()
+                _put_ss(buf, self.token)
                 _put_ss(buf, self.name)
+                _put_ss(buf, self.epoch)
                 buf += seq.to_bytes(8, "big")
                 _put_ss(buf, self.vhost)
                 buf += len(ops).to_bytes(4, "big")

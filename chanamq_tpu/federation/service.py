@@ -23,7 +23,8 @@ from ..amqp.properties import BasicProperties
 from ..broker.broker import BrokerError
 from ..cluster.dataplane import _Cursor
 from ..cluster.rpc import RpcError, RpcServer
-from ..streams.segment import Segment, unpack_records_indexed
+from ..streams.segment import (
+    Segment, unpack_records, unpack_records_indexed)
 from .link import FED_PUBLISH, FED_SHIP, FED_TX, FederationLink
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -42,7 +43,7 @@ class FederationService:
         self, broker: "Broker", *, node_name: str = "",
         interface: str = "127.0.0.1", port: int = 0, window: int = 4,
         retry_s: float = 0.5, idle_s: float = 0.2,
-        links: Optional[list[dict]] = None,
+        links: Optional[list[dict]] = None, auth_token: str = "",
     ) -> None:
         self.broker = broker
         self.metrics = broker.metrics
@@ -50,6 +51,12 @@ class FederationService:
         self.window = max(1, window)
         self.retry_s = retry_s
         self.idle_s = idle_s
+        #: shared secret every inbound fed.* call must present when set.
+        #: The federation listener sits outside the AMQP SASL/ACL path,
+        #: so this token is its whole admission control — leave it empty
+        #: only on a trusted network. Outbound links default to the same
+        #: value (symmetric deployments configure one secret per pair).
+        self.auth_token = auth_token
         self.server = RpcServer(interface, port)
         self.server.register("fed.hello", self._h_hello)
         self.server.register("fed.resume", self._h_resume)
@@ -64,9 +71,15 @@ class FederationService:
         #: soak can't tell the clusters' emissions apart there — this log
         #: is per-service and is what the determinism gate compares.
         self.events: deque = deque(maxlen=_EVENT_LOG_MAX)
-        #: last applied Tx batch sequence per link name (idempotent retry:
-        #: a batch the link re-ships after a drop mid-reply applies once)
-        self._applied_tx: dict[str, int] = {}
+        #: last applied Tx-batch / forwarded-publish sequence per link,
+        #: keyed by the shipper's per-boot epoch: a batch the link
+        #: re-ships after a drop mid-reply applies once, while a
+        #: restarted shipper (sequences reset to 0 under a fresh epoch)
+        #: starts a new dedup scope instead of being swallowed by the
+        #: previous incarnation's high-water mark. One entry per link —
+        #: a new epoch replaces the old one, so the maps stay bounded.
+        self._applied_tx: dict[str, tuple[str, int]] = {}
+        self._applied_pub: dict[str, tuple[str, int]] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -150,6 +163,22 @@ class FederationService:
 
     # -- receiving side ----------------------------------------------------
 
+    def _check_token(self, token) -> None:
+        """Admission control for every inbound fed.* call (control and
+        data plane): when the service has an ``auth_token``, a caller
+        that doesn't present it is refused before any queue is declared
+        or any byte is applied."""
+        if self.auth_token and str(token or "") != self.auth_token:
+            self.metrics.federation_auth_failures += 1
+            raise RpcError("auth", "bad federation token")
+
+    @staticmethod
+    def _already_applied(table: dict, link: str, epoch: str,
+                         seq: int) -> bool:
+        entry = table.get(link)
+        return (entry is not None and entry[0] == epoch
+                and seq <= entry[1])
+
     async def _mirror_queue(self, vhost: str, name: str):
         """The mirror stream for an inbound ship/resume, declared on first
         contact. Mirrors are receive-only by convention: local publishes
@@ -167,14 +196,17 @@ class FederationService:
         return queue
 
     async def _h_hello(self, payload: dict) -> dict:
+        self._check_token(payload.get("token"))
         link = str(payload.get("link", ""))
         node = str(payload.get("node", ""))
-        log.info("federation hello from link=%s node=%s", link, node)
+        log.info("federation hello from link=%s node=%s epoch=%s",
+                 link, node, str(payload.get("epoch", "")))
         return {"node": self.node_name, "ok": True}
 
     async def _h_resume(self, payload: dict) -> dict:
         """Resume point for one mirrored queue: the mirror's next expected
         offset (ship from here) plus its committed-cursor map."""
+        self._check_token(payload.get("token"))
         queue = await self._mirror_queue(
             str(payload.get("vhost", "/")), str(payload.get("queue", "")))
         return {
@@ -186,6 +218,7 @@ class FederationService:
         """Apply a batch of mirrored cursor commits, monotonically (the
         mirror may already be ahead from an earlier flush that raced the
         link drop — ``_commit`` keeps the max)."""
+        self._check_token(payload.get("token"))
         vhost = str(payload.get("vhost", "/"))
         qname = str(payload.get("queue", ""))
         cursors = payload.get("cursors") or {}
@@ -201,12 +234,23 @@ class FederationService:
     async def _h_ship(self, payload: memoryview):
         """Apply one shipped sealed segment.
 
-        Wire: ss vhost | ss queue | u64 base | u64 last | u64 first_ts |
-        u64 last_ts | u32 crc32 | u32 blob-len | blob. Replies the
-        mirror's next expected offset (u64) — also on an idempotent
-        duplicate, so a shipper that lost our ack mid-link-drop
-        fast-forwards instead of re-sending the whole window."""
+        Wire: ss token | ss vhost | ss queue | u64 base | u64 last |
+        u64 first_ts | u64 last_ts | u32 crc32 | u32 blob-len | blob.
+        Replies the mirror's next expected offset (u64) — also on an
+        idempotent duplicate, so a shipper that lost our ack
+        mid-link-drop fast-forwards instead of re-sending the whole
+        window.
+
+        The claimed range is validated against the decoded payload, not
+        just the CRC (which only guards transport corruption): ``last``
+        must cover ``base`` and every record offset must fall inside
+        ``[base, last]`` in ascending order — otherwise a buggy or
+        hostile shipper could splice a range the blob doesn't actually
+        cover and permanently corrupt the mirror's offset space. Sparse
+        blobs (key-compaction holes, including fully-compacted empties)
+        remain legal: holes are allowed, out-of-range records are not."""
         cur = _Cursor(payload)
+        self._check_token(cur.ss())
         vhost = cur.ss()
         qname = cur.ss()
         base = cur.u64()
@@ -215,6 +259,9 @@ class FederationService:
         last_ts = cur.u64()
         crc = cur.u32()
         blob = cur.blob()
+        if last < base:
+            self.metrics.federation_invalid_segments += 1
+            raise RpcError("bad-range", f"last {last} < base {base}")
         queue = await self._mirror_queue(vhost, qname)
         if queue._active:
             # locally-appended records on a mirror (operator error): seal
@@ -231,6 +278,14 @@ class FederationService:
             self.metrics.federation_crc_failures += 1
             raise RpcError("crc", "segment crc mismatch")
         data = bytes(blob)
+        prev = base - 1
+        for rec in unpack_records(data):
+            if rec.offset <= prev or rec.offset > last:
+                self.metrics.federation_invalid_segments += 1
+                raise RpcError(
+                    "bad-range",
+                    f"record offset {rec.offset} outside [{base}, {last}]")
+            prev = rec.offset
         seg = Segment(base, last, first_ts, last_ts, len(data),
                       unpack_records_indexed(data, base, last))
         queue._segments.append(seg)
@@ -251,19 +306,26 @@ class FederationService:
     async def _h_tx(self, payload: memoryview):
         """Apply one federated Tx batch all-or-nothing.
 
-        Wire: ss link | u64 seq | ss vhost | u32 count | count * (ss
-        exchange | ss rkey | u32 header-len | header | u32 body-len |
-        body). On a WalStore the replay runs inside the same
-        ``tx_begin``/``tx_seal`` scope a local Tx.Commit uses, so the
-        whole batch lands as one ``tx_batch`` WAL record. Replies the
-        applied sequence (u64); an already-applied sequence acks without
-        re-publishing (idempotent retry after a lost reply)."""
+        Wire: ss token | ss link | ss epoch | u64 seq | ss vhost |
+        u32 count | count * (ss exchange | ss rkey | u32 header-len |
+        header | u32 body-len | body). On a WalStore the replay runs
+        inside the same ``tx_begin``/``tx_seal`` scope a local Tx.Commit
+        uses, so the whole batch lands as one ``tx_batch`` WAL record.
+        Replies the applied sequence (u64); an already-applied sequence
+        *from the same shipper epoch* acks without re-publishing
+        (idempotent retry after a lost reply), while a fresh epoch —
+        a restarted shipper whose sequences restart at 1 — opens a new
+        dedup scope so its batches are never mistaken for replays of the
+        previous incarnation's."""
         cur = _Cursor(payload)
+        self._check_token(cur.ss())
         link = cur.ss()
+        epoch = cur.ss()
         seq = cur.u64()
         vhost = cur.ss()
         count = cur.u32()
-        if seq <= self._applied_tx.get(link, 0):
+        if self._already_applied(self._applied_tx, link, epoch, seq):
+            self.metrics.federation_duplicate_forwards += 1
             return [_u64(seq)]
         ops = []
         for _ in range(count):
@@ -288,21 +350,32 @@ class FederationService:
             raise
         if scoped:
             store.tx_seal()
-        self._applied_tx[link] = seq
+        self._applied_tx[link] = (epoch, seq)
         self.metrics.federation_tx_applied += 1
         return [_u64(seq)]
 
     async def _h_publish(self, payload: memoryview):
-        """Apply one forwarded (DLX) publish. Wire: ss vhost | ss exchange
-        | ss rkey | u32 header-len | header | u32 body-len | body. A
-        missing exchange drops the message, matching local DLX
-        semantics."""
+        """Apply one forwarded (DLX) publish.
+
+        Wire: ss token | ss link | ss epoch | u64 seq | ss vhost |
+        ss exchange | ss rkey | u32 header-len | header | u32 body-len |
+        body. Forwards carry the same per-link (epoch, seq) identity as
+        Tx batches, so a retry after a link drop mid-reply acks without
+        publishing a duplicate DLX message. A missing exchange drops the
+        message, matching local DLX semantics."""
         cur = _Cursor(payload)
+        self._check_token(cur.ss())
+        link = cur.ss()
+        epoch = cur.ss()
+        seq = cur.u64()
         vhost = cur.ss()
         exchange = cur.ss()
         rkey = cur.ss()
         header = bytes(cur.blob())
         body = bytes(cur.blob())
+        if self._already_applied(self._applied_pub, link, epoch, seq):
+            self.metrics.federation_duplicate_forwards += 1
+            return None
         _, _, props = BasicProperties.decode_header(header)
         try:
             await self.broker.publish(
@@ -310,6 +383,7 @@ class FederationService:
         except BrokerError as exc:
             log.warning("federated publish to '%s' dropped: %s",
                         exchange, exc.text)
+        self._applied_pub[link] = (epoch, seq)
         return None
 
 

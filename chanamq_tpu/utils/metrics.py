@@ -293,6 +293,11 @@ class Metrics:
         self.federation_tx_publishes = 0
         self.federation_tx_applied = 0
         self.federation_outbox_dropped = 0
+        self.federation_outbox_dropped_publish = 0
+        self.federation_outbox_dropped_tx = 0
+        self.federation_duplicate_forwards = 0
+        self.federation_invalid_segments = 0
+        self.federation_auth_failures = 0
         # anti-entropy peers skipped because the lifecycle machine marked
         # them LEFT (satellite of the federation PR)
         self.lifecycle_left_peer_skipped = 0
@@ -495,6 +500,15 @@ class Metrics:
             "federation_tx_publishes": self.federation_tx_publishes,
             "federation_tx_applied": self.federation_tx_applied,
             "federation_outbox_dropped": self.federation_outbox_dropped,
+            "federation_outbox_dropped_publish":
+                self.federation_outbox_dropped_publish,
+            "federation_outbox_dropped_tx":
+                self.federation_outbox_dropped_tx,
+            "federation_duplicate_forwards":
+                self.federation_duplicate_forwards,
+            "federation_invalid_segments":
+                self.federation_invalid_segments,
+            "federation_auth_failures": self.federation_auth_failures,
             "lifecycle_left_peer_skipped": self.lifecycle_left_peer_skipped,
         }
         for key, hist in self.trace_stage_us.items():

@@ -90,8 +90,9 @@ async def collect(ch, queue, n, *, offset="first", tag="", ack=True,
     return got
 
 
-def _ship_payload(vhost, qname, base, last, blob, crc=None):
+def _ship_payload(vhost, qname, base, last, blob, crc=None, token=""):
     head = bytearray()
+    _put_ss(head, token)
     _put_ss(head, vhost)
     _put_ss(head, qname)
     head += base.to_bytes(8, "big")
@@ -102,6 +103,41 @@ def _ship_payload(vhost, qname, base, last, blob, crc=None):
     head += crc.to_bytes(4, "big")
     head += len(blob).to_bytes(4, "big")
     return memoryview(bytes(head) + blob)
+
+
+def _tx_payload(link, epoch, seq, publishes, token=""):
+    """FED_TX wire: publishes is [(exchange, rkey, header, body), ...]."""
+    buf = bytearray()
+    _put_ss(buf, token)
+    _put_ss(buf, link)
+    _put_ss(buf, epoch)
+    buf += seq.to_bytes(8, "big")
+    _put_ss(buf, "/")
+    buf += len(publishes).to_bytes(4, "big")
+    for exchange, rkey, header, body in publishes:
+        _put_ss(buf, exchange)
+        _put_ss(buf, rkey)
+        buf += len(header).to_bytes(4, "big")
+        buf += header
+        buf += len(body).to_bytes(4, "big")
+        buf += body
+    return memoryview(bytes(buf))
+
+
+def _pub_payload(link, epoch, seq, exchange, rkey, header, body, token=""):
+    buf = bytearray()
+    _put_ss(buf, token)
+    _put_ss(buf, link)
+    _put_ss(buf, epoch)
+    buf += seq.to_bytes(8, "big")
+    _put_ss(buf, "/")
+    _put_ss(buf, exchange)
+    _put_ss(buf, rkey)
+    buf += len(header).to_bytes(4, "big")
+    buf += header
+    buf += len(body).to_bytes(4, "big")
+    buf += body
+    return memoryview(bytes(buf))
 
 
 def _records(base, last, prefix="r"):
@@ -255,6 +291,161 @@ async def test_ship_crc_mismatch_rejected():
         await b_srv.stop()
 
 
+async def test_ship_rejects_bad_range_claims():
+    """CRC only guards transport corruption: a shipper claiming a range
+    its blob doesn't cover must be refused before the splice, or the
+    mirror's offset space corrupts permanently."""
+    b_srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                         store=MemoryStore())
+    await b_srv.start()
+    fed_b = FederationService(b_srv.broker, node_name="b", port=0)
+    await fed_b.start()
+    try:
+        # inverted range: last < base
+        blob = pack_records(_records(1, 2))
+        with pytest.raises(RpcError) as exc:
+            await fed_b._h_ship(_ship_payload("/", "mq", 5, 1, blob))
+        assert exc.value.code == "bad-range"
+        # records outside the claimed range: blob holds offsets 1..5 but
+        # the header claims only 1..2 (would advance next_offset past
+        # records the mirror never stored)
+        wide = pack_records(_records(1, 5))
+        with pytest.raises(RpcError) as exc:
+            await fed_b._h_ship(_ship_payload("/", "mq", 1, 2, wide))
+        assert exc.value.code == "bad-range"
+        assert b_srv.broker.metrics.federation_invalid_segments == 2
+        # nothing spliced: the mirror still expects offset 1, and a
+        # well-formed ship (sparse is fine — compaction holes are legal)
+        # goes through afterwards
+        sparse = pack_records([r for r in _records(1, 4) if r.offset != 2])
+        reply = await fed_b._h_ship(_ship_payload("/", "mq", 1, 4, sparse))
+        assert int.from_bytes(reply[0], "big") == 5
+    finally:
+        await fed_b.stop()
+        await b_srv.stop()
+
+
+async def test_auth_token_gates_every_handler():
+    b_srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                         store=MemoryStore())
+    await b_srv.start()
+    fed_b = FederationService(b_srv.broker, node_name="b", port=0,
+                              auth_token="sesame")
+    await fed_b.start()
+    try:
+        with pytest.raises(RpcError) as exc:
+            await fed_b._h_hello({"link": "x", "node": "a"})
+        assert exc.value.code == "auth"
+        with pytest.raises(RpcError):
+            await fed_b._h_resume({"vhost": "/", "queue": "mq",
+                                   "token": "wrong"})
+        blob = pack_records(_records(1, 2))
+        with pytest.raises(RpcError) as exc:
+            await fed_b._h_ship(_ship_payload("/", "mq", 1, 2, blob))
+        assert exc.value.code == "auth"
+        body = b"x"
+        header = BasicProperties().encode_header(len(body))
+        with pytest.raises(RpcError):
+            await fed_b._h_tx(_tx_payload(
+                "l", "e", 1, [("", "q", header, body)], token="wrong"))
+        with pytest.raises(RpcError):
+            await fed_b._h_publish(_pub_payload(
+                "l", "e", 1, "", "q", header, body))
+        assert b_srv.broker.metrics.federation_auth_failures == 5
+        # nothing auto-declared on refused calls
+        assert "mq" not in b_srv.broker.vhosts["/"].queues
+        # the right token passes
+        reply = await fed_b._h_ship(
+            _ship_payload("/", "mq", 1, 2, blob, token="sesame"))
+        assert int.from_bytes(reply[0], "big") == 3
+    finally:
+        await fed_b.stop()
+        await b_srv.stop()
+
+
+async def test_authed_link_ships_end_to_end():
+    """A link configured with the remote's token comes up and ships;
+    the token rides fed.hello, the cursor mirror and the data plane."""
+    b_srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                         store=MemoryStore())
+    await b_srv.start()
+    fed_b = FederationService(b_srv.broker, node_name="cluster-b", port=0,
+                              auth_token="sesame")
+    await fed_b.start()
+    a_srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                         store=MemoryStore())
+    await a_srv.start()
+    fed_a = FederationService(
+        a_srv.broker, node_name="cluster-a", port=0,
+        retry_s=0.05, idle_s=0.02,
+        links=[{"name": "to-b", "host": "127.0.0.1", "port": fed_b.port,
+                "queues": ["fq"], "token": "sesame"}])
+    await fed_a.start()
+    try:
+        await eventually(lambda: fed_a.links[0].state == "up",
+                         what="authed link up")
+        # and a wrong token never comes up (refused at fed.hello)
+        fed_bad = FederationService(
+            a_srv.broker, node_name="cluster-bad", port=0,
+            retry_s=0.05, idle_s=0.02,
+            links=[{"name": "to-b", "host": "127.0.0.1",
+                    "port": fed_b.port, "queues": ["fq"],
+                    "token": "wrong"}])
+        await fed_bad.start()
+        bad = fed_bad.links[0]
+        await eventually(
+            lambda: bad.last_error is not None and "auth" in bad.last_error,
+            what="bad-token link refused")
+        assert bad.state == "down"
+        await fed_bad.stop()
+    finally:
+        await fed_a.stop()
+        await a_srv.stop()
+        await fed_b.stop()
+        await b_srv.stop()
+
+
+async def test_outbox_sheds_publishes_before_tx_batches(monkeypatch):
+    """At the outbox bound, single DLX forwards are shed before whole
+    committed Tx batches, and drops are counted per kind."""
+    from chanamq_tpu.federation import link as link_module
+
+    b_srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                         store=MemoryStore())
+    await b_srv.start()
+    fed = FederationService(
+        b_srv.broker, node_name="b", port=0,
+        links=[{"name": "l", "host": "127.0.0.1", "port": 1,
+                "queues": []}])
+    link = fed.links[0]  # never started: staging is pure local state
+    try:
+        monkeypatch.setattr(link_module, "_OUTBOX_MAX", 4)
+        header, body = b"h", b"b"
+        link.queue_tx([("ex", "rk", header, body)])
+        link.queue_publish("ex", "rk", header, body)
+        link.queue_tx([("ex", "rk", header, body)])
+        link.queue_publish("ex", "rk", header, body)
+        # outbox full at 4: the next stage sheds the OLDEST PUBLISH,
+        # not the older tx batch at the head
+        link.queue_tx([("ex", "rk", header, body)])
+        kinds = [item[0] for item in link.outbox]
+        assert kinds == ["tx", "tx", "publish", "tx"]
+        metrics = b_srv.broker.metrics
+        assert metrics.federation_outbox_dropped_publish == 1
+        assert metrics.federation_outbox_dropped_tx == 0
+        assert metrics.federation_outbox_dropped == 1
+        # further pressure sheds the remaining publish first; once the
+        # outbox is all tx, the oldest batch goes — counted as such
+        link.queue_tx([("ex", "rk", header, body)])
+        link.queue_tx([("ex", "rk", header, body)])
+        assert [item[0] for item in link.outbox] == ["tx"] * 4
+        assert metrics.federation_outbox_dropped_publish == 2
+        assert metrics.federation_outbox_dropped_tx == 1
+        assert metrics.federation_outbox_dropped == 3
+    finally:
+        await b_srv.stop()
+
+
 async def test_resume_rejects_non_stream_queue():
     b_srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0)
     await b_srv.start()
@@ -371,26 +562,85 @@ async def test_tx_batch_replay_is_idempotent():
         await ch.queue_declare("txq")
         body = b"payload"
         header = BasicProperties(delivery_mode=2).encode_header(len(body))
-        buf = bytearray()
-        _put_ss(buf, "from-a")
-        buf += (1).to_bytes(8, "big")  # seq
-        _put_ss(buf, "/")
-        buf += (2).to_bytes(4, "big")  # count
-        for _ in range(2):
-            _put_ss(buf, "")            # default exchange
-            _put_ss(buf, "txq")
-            buf += len(header).to_bytes(4, "big")
-            buf += header
-            buf += len(body).to_bytes(4, "big")
-            buf += body
-        payload = memoryview(bytes(buf))
+        publishes = [("", "txq", header, body)] * 2
+        payload = _tx_payload("from-a", "boot-1", 1, publishes)
         reply = await fed_b._h_tx(payload)
         assert int.from_bytes(reply[0], "big") == 1
         # a retried batch (lost reply) acks without re-publishing
         reply = await fed_b._h_tx(payload)
         assert int.from_bytes(reply[0], "big") == 1
         assert b_srv.broker.metrics.federation_tx_applied == 1
+        assert b_srv.broker.metrics.federation_duplicate_forwards == 1
         queue = b_srv.broker.get_queue("/", "txq")
+        assert queue.message_count == 2
+        await conn.close()
+    finally:
+        await fed_b.stop()
+        await b_srv.stop()
+
+
+async def test_tx_dedup_scoped_by_shipper_epoch():
+    """A restarted shipper's sequences restart at 1 under a fresh epoch;
+    the receiver must apply them instead of swallowing everything below
+    the previous incarnation's high-water mark."""
+    b_srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                         store=MemoryStore())
+    await b_srv.start()
+    fed_b = FederationService(b_srv.broker, node_name="b", port=0)
+    await fed_b.start()
+    try:
+        conn = await AMQPClient.connect("127.0.0.1", b_srv.bound_port)
+        ch = await conn.channel()
+        await ch.queue_declare("txq")
+        body = b"payload"
+        header = BasicProperties(delivery_mode=2).encode_header(len(body))
+        publishes = [("", "txq", header, body)]
+        # first incarnation ships seqs 1..3
+        for seq in (1, 2, 3):
+            await fed_b._h_tx(
+                _tx_payload("from-a", "boot-1", seq, publishes))
+        # shipper restarts: new epoch, seq restarts at 1 — must APPLY,
+        # not ack as a duplicate of boot-1's seq 1
+        reply = await fed_b._h_tx(
+            _tx_payload("from-a", "boot-2", 1, publishes))
+        assert int.from_bytes(reply[0], "big") == 1
+        assert b_srv.broker.metrics.federation_tx_applied == 4
+        queue = b_srv.broker.get_queue("/", "txq")
+        assert queue.message_count == 4
+        # within the new epoch, retries still dedup
+        await fed_b._h_tx(_tx_payload("from-a", "boot-2", 1, publishes))
+        assert queue.message_count == 4
+        await conn.close()
+    finally:
+        await fed_b.stop()
+        await b_srv.stop()
+
+
+async def test_forwarded_publish_replay_is_idempotent():
+    """FED_PUBLISH carries the same per-link (epoch, seq) identity as
+    Tx batches: a retry after a lost ack must not duplicate the DLX
+    message, and a fresh epoch opens a new dedup scope."""
+    b_srv = BrokerServer(host="127.0.0.1", port=0, heartbeat_s=0,
+                         store=MemoryStore())
+    await b_srv.start()
+    fed_b = FederationService(b_srv.broker, node_name="b", port=0)
+    await fed_b.start()
+    try:
+        conn = await AMQPClient.connect("127.0.0.1", b_srv.bound_port)
+        ch = await conn.channel()
+        await ch.queue_declare("dead")
+        body = b"corpse"
+        header = BasicProperties(delivery_mode=2).encode_header(len(body))
+        payload = _pub_payload("from-a", "boot-1", 1, "", "dead",
+                               header, body)
+        await fed_b._h_publish(payload)
+        await fed_b._h_publish(payload)  # retry after a lost ack
+        queue = b_srv.broker.get_queue("/", "dead")
+        assert queue.message_count == 1
+        assert b_srv.broker.metrics.federation_duplicate_forwards == 1
+        # new shipper incarnation: seq 1 again, but a different message
+        await fed_b._h_publish(_pub_payload(
+            "from-a", "boot-2", 1, "", "dead", header, body))
         assert queue.message_count == 2
         await conn.close()
     finally:
