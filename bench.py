@@ -2565,6 +2565,25 @@ def main() -> None:
         ], value_label="r0.01")
         return
 
+    if "--otel-overhead" in sys.argv:
+        # OTLP-export cost: tracing on at the default 1% sample rate in
+        # BOTH variants so the delta isolates what the otel layer adds —
+        # the per-publish header probe, the finish-hook enqueue, and the
+        # background flusher cycling against a dead collector endpoint
+        # (port 1 refuses instantly, so every flush exercises the
+        # ReconnectBackoff path, the worst production-adjacent case).
+        # Held to the same <= 2% budget as every observability subsystem.
+        run_overhead("otel_overhead_pct", [
+            ("trace", {"CHANAMQ_TRACE_ENABLED": "true",
+                       "CHANAMQ_TRACE_SAMPLE_RATE": "0.01"}),
+            ("trace+otel", {"CHANAMQ_TRACE_ENABLED": "true",
+                            "CHANAMQ_TRACE_SAMPLE_RATE": "0.01",
+                            "CHANAMQ_OTEL_ENABLED": "true",
+                            "CHANAMQ_OTEL_ENDPOINT":
+                                "http://127.0.0.1:1/v1/traces"}),
+        ], budget_pct=-2.0)
+        return
+
     if "--telemetry-overhead" in sys.argv:
         # per-entity sampling cost: the headline transient/autoAck spec
         # with telemetry off vs on at a 100 ms tick (10x the default

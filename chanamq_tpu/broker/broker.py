@@ -26,6 +26,7 @@ from ..amqp.constants import ErrorCode, ExchangeType
 from ..amqp.properties import BasicProperties
 from ..amqp.value_codec import Timestamp
 from ..cluster.idgen import IdGenerator
+from ..otel.context import stamp_headers
 from ..flow import (
     MemoryAccountant,
     STAGE_PAGE,
@@ -233,6 +234,9 @@ class Broker:
         # chana.mq.federation.enabled — the seal/commit/DLX/Tx hooks are
         # one attribute load + identity check when off
         self.federation: Optional[Any] = None
+        # OTLP span exporter (chanamq_tpu/otel/): None unless
+        # chana.mq.otel.enabled — trace completion pays one hook check
+        self.otel: Optional[Any] = None
         self.blocked = False
         self.blocked_reason = ""  # wire-visible cause (Connection.Blocked)
         self._mem_over = False    # resident_bytes above the RAM watermark
@@ -329,7 +333,8 @@ class Broker:
             exchange, routing_key, props, body, header, exrk, confirmed = entry
             metrics.published(len(body))
             if trace.ACTIVE is not None:
-                tr = trace.ACTIVE.begin_publish(self.trace_node)
+                tr = trace.ACTIVE.begin_publish(self.trace_node,
+                                                props.headers)
                 if tr is not None:
                     # the whole flush routed as one kernel call: each
                     # sampled message carries the batch's ROUTE window
@@ -1505,7 +1510,8 @@ class Broker:
         tr = None
         t_route = 0
         if trace.ACTIVE is not None:
-            tr = trace.ACTIVE.begin_publish(self.trace_node)
+            tr = trace.ACTIVE.begin_publish(self.trace_node,
+                                            properties.headers)
             if tr is not None:
                 t_route = time.perf_counter_ns()
         vhost, queue_names = self._publish_route(
@@ -1548,7 +1554,8 @@ class Broker:
         tr = None
         t_route = 0
         if trace.ACTIVE is not None:
-            tr = trace.ACTIVE.begin_publish(self.trace_node)
+            tr = trace.ACTIVE.begin_publish(self.trace_node,
+                                            properties.headers)
             if tr is not None:
                 t_route = time.perf_counter_ns()
         prof = profile.ACTIVE
@@ -1653,7 +1660,8 @@ class Broker:
         self.metrics.published(len(body))
         tr = None
         if trace.ACTIVE is not None:
-            tr = trace.ACTIVE.begin_publish(self.trace_node)
+            tr = trace.ACTIVE.begin_publish(self.trace_node,
+                                            properties.headers)
             if tr is not None:
                 # the route is a dict hit: charge it as one stamp pair
                 t_route = time.perf_counter_ns()
@@ -1751,18 +1759,41 @@ class Broker:
         residencies), push to every queue with body_size computed once
         (fanout passivation safety), and record the attribution window."""
         mark0 = self.store.mark()
-        message = Message(
-            self.idgen.next_id(), properties, body, exchange_name, routing_key,
-            properties.expiration_ms(), header_raw=header_raw,
-        )
-        message.exrk_raw = exrk_raw
         tr = None
         t_enq = 0
         if trace.ACTIVE is not None:
             tr = trace.ACTIVE.current
             if tr is not None:
-                message.trace = tr
                 t_enq = time.perf_counter_ns()
+                if tr.w3c is not None:
+                    # propagated context: one copy-on-write header rewrite
+                    # here covers EVERY egress of this message — consumer
+                    # deliveries, the persisted blob, stream records (and
+                    # through them federated FED_SHIP segments), and
+                    # staged FED_TX/FED_PUBLISH frames all render from
+                    # these properties once header_raw is dropped
+                    properties, changed = stamp_headers(properties, tr.w3c)
+                    if changed:
+                        header_raw = None
+                # routing attributes for the trace query layer / OTLP
+                # render (sampled messages only; setdefault keeps the
+                # origin's routing when a clustered push re-applies)
+                tr.attr("vhost", queues[0].vhost)
+                tr.attr("exchange", exchange_name)
+                tr.attr("routing_key", routing_key)
+                tr.attr("queue", ",".join(q.name for q in queues))
+                registry = self.tenancy
+                if registry is not None:
+                    owner = registry.tenant_of_vhost(queues[0].vhost)
+                    if owner is not None:
+                        tr.attr("tenant", owner)
+        message = Message(
+            self.idgen.next_id(), properties, body, exchange_name, routing_key,
+            properties.expiration_ms(), header_raw=header_raw,
+        )
+        message.exrk_raw = exrk_raw
+        if tr is not None:
+            message.trace = tr
         message.refer_count = len(queues)
         self.account_message(message)
         # streams never reference the shared Message after push (the log
@@ -1788,6 +1819,14 @@ class Broker:
         if tr is not None:
             tr.span(trace.ENQUEUE, t_enq, time.perf_counter_ns(),
                     self.trace_node)
+            if tr.w3c is not None and all(q.is_stream for q in queues):
+                # stream records are COPIES of this message: nothing ever
+                # delivers/settles this Message object, so the origin half
+                # of a forced trace completes at append. The consumer side
+                # (local cursor reads, or a federated mirror) continues
+                # under the same W3C trace id via the stamped record
+                # headers. Seeded traces keep their existing lifecycle.
+                trace.ACTIVE.finish(tr)
         if marks is not None:
             mark1 = self.store.mark()
             if mark1 > mark0:

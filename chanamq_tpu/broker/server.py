@@ -409,6 +409,7 @@ async def run_node(config) -> None:
     telemetry = None
     control = None
     federation = None
+    otel = None
     started = False
     stop_event = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -445,6 +446,20 @@ async def run_node(config) -> None:
             from .. import trace as trace_mod
 
             trace_mod.enable_from_config(config, server.broker)
+        # OTLP span exporter: hooks trace completion, so it must come
+        # after tracing is installed. Without an endpoint it still arms
+        # the bounded queue behind GET /admin/otel/spans (pull mode).
+        if config.bool("chana.mq.otel.enabled"):
+            from ..otel.export import OtelExporter
+
+            otel = OtelExporter(
+                server.broker,
+                endpoint=config.str("chana.mq.otel.endpoint"),
+                flush_ms=config.int("chana.mq.otel.flush-ms"),
+                max_batch=config.int("chana.mq.otel.max-batch"),
+                queue_size=config.int("chana.mq.otel.queue-size"))
+            await otel.start()
+            server.broker.otel = otel
         # cost ledger + sampling profiler (third ACTIVE-gate subsystem):
         # armed before traffic so stage counters cover the whole run, and
         # before the cluster so cluster-push batches are attributed
@@ -680,6 +695,8 @@ async def run_node(config) -> None:
             await forecaster.stop()
         if federation:
             await federation.stop()
+        if otel:
+            await otel.stop()
         if cluster:
             await cluster.stop()
         if started:

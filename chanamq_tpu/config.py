@@ -312,6 +312,21 @@ DEFAULTS: dict[str, Any] = {
     "chana.mq.trace.slow-ms": 250,
     # structured JSON log lines stamped with node id + active trace id
     "chana.mq.log.json": False,
+    # OTLP span export (chanamq_tpu/otel/): drains completed traces into
+    # OTLP/HTTP JSON batches. Requires chana.mq.trace.enabled to have
+    # anything to export. With an empty endpoint the exporter runs in
+    # collector-less mode: completed traces queue (bounded) for the pull
+    # fallback GET /admin/otel/spans instead of being pushed.
+    "chana.mq.otel.enabled": False,
+    # OTLP/HTTP collector URL, e.g. http://127.0.0.1:4318/v1/traces
+    "chana.mq.otel.endpoint": "",
+    # push flush window (batches post at most this often)
+    "chana.mq.otel.flush-ms": 1000,
+    # max traces rendered into one OTLP/HTTP POST
+    "chana.mq.otel.max-batch": 64,
+    # bounded exporter queue; overflow (or flow stage >= 1) sheds with
+    # the otel_spans_shed counter instead of growing memory
+    "chana.mq.otel.queue-size": 1024,
     # data-parallel tensorized router (chanamq_tpu/router/): fused single
     # node publishes defer into a per-connection buffer and the whole read
     # batch routes through compiled binding tables in one kernel call.

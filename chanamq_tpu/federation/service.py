@@ -14,12 +14,14 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 import zlib
 from collections import deque
 from typing import TYPE_CHECKING, Optional
 
-from .. import events
+from .. import events, trace
 from ..amqp.properties import BasicProperties
+from ..otel.context import extract as w3c_extract
 from ..broker.broker import BrokerError
 from ..cluster.dataplane import _Cursor
 from ..cluster.rpc import RpcError, RpcServer
@@ -34,6 +36,10 @@ log = logging.getLogger("chanamq.federation")
 
 # bounded transition log: enough for a soak's full decision history
 _EVENT_LOG_MAX = 512
+
+# mirror-side {offset: Trace} contexts awaiting their first dispatch —
+# bounded per queue so a mirror nobody consumes can't grow without limit
+_FED_TRACE_CAP = 1024
 
 
 class FederationService:
@@ -279,6 +285,9 @@ class FederationService:
             raise RpcError("crc", "segment crc mismatch")
         data = bytes(blob)
         prev = base - 1
+        rt = trace.ACTIVE
+        fed_traces: "dict | None" = None
+        t_apply = time.perf_counter_ns() if rt is not None else 0
         for rec in unpack_records(data):
             if rec.offset <= prev or rec.offset > last:
                 self.metrics.federation_invalid_segments += 1
@@ -286,6 +295,18 @@ class FederationService:
                     "bad-range",
                     f"record offset {rec.offset} outside [{base}, {last}]")
             prev = rec.offset
+            # cross-cluster parenting (ISSUE 20): the validation walk is
+            # already touching every record, so a cheap substring probe
+            # finds the ones whose origin stamped a W3C context into the
+            # header; each mints a mirror-side forced trace parented (via
+            # the header's traceparent = the origin broker's root span)
+            # into the same trace id the producer started
+            if rt is not None and b"traceparent" in rec.header_raw:
+                tr = self._lift_record_context(rt, rec, vhost, qname)
+                if tr is not None:
+                    if fed_traces is None:
+                        fed_traces = {}
+                    fed_traces[rec.offset] = tr
         seg = Segment(base, last, first_ts, last_ts, len(data),
                       unpack_records_indexed(data, base, last))
         queue._segments.append(seg)
@@ -298,10 +319,37 @@ class FederationService:
                 vhost, qname, base, last, first_ts, last_ts,
                 len(data), data))
         self.metrics.federation_segments_applied += 1
+        if fed_traces:
+            now = time.perf_counter_ns()
+            node = self.node_name or rt.node
+            for tr in fed_traces.values():
+                tr.span(trace.REMOTE_APPLY, t_apply, now, node)
+            existing = queue.fed_traces
+            if existing is None:
+                existing = queue.fed_traces = {}
+            existing.update(fed_traces)
+            while len(existing) > _FED_TRACE_CAP:
+                existing.pop(next(iter(existing)))
+            self.metrics.trace_ctx_recv += len(fed_traces)
         queue._enforce_retention()
         queue._evict_cache(keep=seg)
         queue.schedule_dispatch()
         return [_u64(queue.next_offset)]
+
+    def _lift_record_context(self, rt, rec, vhost: str, qname: str):
+        """Mint the mirror-side half of a propagated trace from a shipped
+        record's stamped traceparent header. Never raises — a record with
+        an undecodable header is simply applied untraced."""
+        try:
+            _, _, props = BasicProperties.decode_header(rec.header_raw)
+        except Exception:
+            return None
+        ctx = w3c_extract(props.headers)
+        if ctx is None:
+            return None
+        return rt.begin_remote(ctx, node=self.node_name or rt.node, attrs={
+            "vhost": vhost, "queue": qname, "exchange": rec.exchange,
+            "routing_key": rec.routing_key, "federated": "1"})
 
     async def _h_tx(self, payload: memoryview):
         """Apply one federated Tx batch all-or-nothing.

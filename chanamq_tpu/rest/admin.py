@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from typing import Optional
 from urllib.parse import parse_qs, unquote
 
@@ -172,8 +173,9 @@ class AdminServer:
         endpoints it lacked."""
         query = query or {}
         if segments == ["metrics"]:
-            # conventional Prometheus scrape path (text exposition format)
-            return ("GET", self._prometheus)
+            # conventional Prometheus scrape path (text exposition format);
+            # ?format=openmetrics upgrades to OpenMetrics with exemplars
+            return ("GET", lambda: self._prometheus(query))
         if not segments or segments[0] != "admin":
             return None
         rest = segments[1:]
@@ -214,9 +216,11 @@ class AdminServer:
         if rest == ["chaos", "clear"]:
             return ("POST", self._chaos_clear)
         if rest == ["traces"]:
-            return ("GET", self._traces)
+            return ("GET", lambda: self._traces(query))
         if len(rest) == 2 and rest[0] == "traces":
             return ("GET", lambda: self._trace_detail(rest[1]))
+        if rest == ["otel", "spans"]:
+            return ("GET", lambda: self._otel_spans(query))
         if rest == ["timeseries"]:
             return ("GET", lambda: self._timeseries(query))
         if len(rest) == 4 and rest[:2] == ["timeseries", "queue"]:
@@ -617,15 +621,37 @@ class AdminServer:
 
     # -- message tracing (chanamq_tpu/trace/) ------------------------------
 
-    def _traces(self) -> dict:
+    # dimension filters understood by /admin/traces; values match the
+    # attrs the publish path stamps on every sampled/forced trace
+    _TRACE_FILTERS = ("queue", "exchange", "vhost", "tenant", "stage")
+
+    def _traces(self, query: dict = None) -> dict:
         from .. import trace
 
+        query = query or {}
         runtime = trace.ACTIVE
         out = {
             "enabled": bool(getattr(self.broker, "trace_enabled", False)),
             "installed": runtime is not None,
         }
         if runtime is not None:
+            filters = {k: query[k] for k in self._TRACE_FILTERS
+                       if k in query}
+            if filters or "min_duration_us" in query or "format" in query:
+                limit = self._q_int(query, "limit", 50, 1, 512)
+                min_us = self._q_int(query, "min_duration_us", 0,
+                                     0, 2 ** 31)
+                matched = runtime.query(limit=limit,
+                                        min_duration_us=min_us, **filters)
+                if query.get("format") == "otlp":
+                    from ..otel.export import (default_resource,
+                                               resource_spans)
+
+                    return resource_spans(
+                        matched, default_resource(self.broker))
+                out["matched"] = len(matched)
+                out["traces"] = [t.to_dict() for t in matched]
+                return out
             out.update(runtime.status())
             stage_hs = self.broker.metrics.trace_stage_us
             out["stage_latency_us"] = {
@@ -652,6 +678,25 @@ class AdminServer:
         out = found.to_dict()
         out["finished"] = found.finished
         return out
+
+    def _otel_spans(self, query: dict) -> dict:
+        """Pull-mode OTLP export: drains the exporter's pending queue
+        when the push exporter is installed (so a collector-less deploy
+        can still scrape spans), otherwise renders the completed rings
+        through the same OTLP shaper."""
+        from .. import trace
+
+        runtime = trace.ACTIVE
+        if runtime is None:
+            raise AdminError("409 Conflict", "tracing not installed")
+        limit = self._q_int(query, "limit", 64, 1, 1024)
+        otel = getattr(self.broker, "otel", None)
+        if otel is not None:
+            return otel.pull(limit)
+        from ..otel.export import default_resource, resource_spans
+
+        return resource_spans(runtime.query(limit=limit),
+                              default_resource(self.broker))
 
     # -- fault injection (chanamq_tpu/chaos/) ------------------------------
 
@@ -795,6 +840,8 @@ class AdminServer:
         "trace_sampled", "trace_completed", "trace_slow",
         "trace_chaos_tagged", "trace_ctx_sent", "trace_ctx_recv",
         "trace_evicted",
+        "otel_forced_samples", "otel_spans_exported", "otel_batches_sent",
+        "otel_export_errors", "otel_spans_shed", "otel_pull_served",
         "telemetry_ticks", "telemetry_saturated_ticks",
         "telemetry_evicted_entities", "telemetry_dropped_entities",
         "alerts_fired", "alerts_resolved",
@@ -816,15 +863,61 @@ class AdminServer:
         "tenancy_quota_refusals_total", "tenancy_acl_denials_total",
     })
 
+    # histogram families that carry OpenMetrics exemplars under
+    # ?format=openmetrics: the end-to-end latency family by name, every
+    # per-stage trace family by prefix. The exempt set names histograms
+    # whose observations have no trace context (replication acks land on
+    # the follower, WAL commits batch many publishes, batch-size is a
+    # count not a latency) — scripts/metrics_lint.py asserts every
+    # exported family is in exactly one of these buckets.
+    _EXEMPLAR_FAMILIES = frozenset({"publish_to_deliver_us"})
+    _EXEMPLAR_PREFIXES = ("trace_",)
+    _EXEMPLAR_EXEMPT = frozenset({
+        "repl_ack_us", "wal_commit_us", "router_batch_size",
+    })
+
     @staticmethod
     def _prom_label(value: str) -> str:
         return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
-    def _prometheus(self) -> str:
+    def _exemplars(self) -> dict:
+        """family -> (trace_id, value_us, unix_ts) drawn from the trace
+        rings, newest first (slow ring preferred — those are the traces
+        an operator actually wants to click through to). Propagated
+        traces expose their W3C id; seeded samples expose the derived
+        id their exported spans carry, so the exemplar always joins."""
+        from .. import trace
+        from ..otel.context import derive_trace_id
+        from ..trace.runtime import STAGE_KEYS
+
+        runtime = trace.ACTIVE
+        if runtime is None:
+            return {}
+        out: dict = {}
+        ts = round(time.time(), 3)
+        for pool in (runtime.slow, runtime.ring):
+            for tr in reversed(pool):
+                tid = (tr.w3c.trace_id if tr.w3c is not None
+                       else derive_trace_id(tr.trace_id))
+                if "publish_to_deliver_us" not in out:
+                    out["publish_to_deliver_us"] = (tid, tr.total_us, ts)
+                for i, s in enumerate(tr.slots):
+                    key = STAGE_KEYS[i]
+                    if s is not None and key not in out:
+                        out[key] = (
+                            tid, max(0.0, (s[1] - s[0]) / 1000.0), ts)
+        return out
+
+    def _prometheus(self, query: dict = None) -> str:
         """Prometheus text exposition of the broker metrics + per-queue
         gauges (exceeds the reference, which had no metrics at all —
         SURVEY.md §5 'observability': throughput was measured by grepping
-        log lines)."""
+        log lines). ``?format=openmetrics`` emits the same series with
+        trace-id exemplars on the hot histograms and a trailing # EOF;
+        the plain scrape stays byte-identical to what it always was."""
+        query = query or {}
+        openmetrics = query.get("format") == "openmetrics"
+        exemplars = self._exemplars() if openmetrics else {}
         out: list[str] = []
         snap = self.broker.metrics_snapshot()
         # on a sharded node every worker scrapes the same metric names;
@@ -845,13 +938,24 @@ class AdminServer:
         # per-bound counts, so emit a running sum with +Inf last
         for name, hist in self.broker.metrics.histograms().items():
             out.append(f"# TYPE chanamq_{name} histogram")
+            ex = exemplars.get(name)
             cumulative = 0
             for bound, count in zip(hist.BOUNDS, hist.buckets):
                 cumulative += count
-                out.append(
-                    f'chanamq_{name}_bucket{{le="{bound}"}} {cumulative}')
-            out.append(
-                f'chanamq_{name}_bucket{{le="+Inf"}} {hist.count}')
+                line = f'chanamq_{name}_bucket{{le="{bound}"}} {cumulative}'
+                if ex is not None and ex[1] <= bound:
+                    # OpenMetrics exemplar on the first bucket that
+                    # covers the sampled value, then consumed — the
+                    # spec allows at most one exemplar per line
+                    tid, value, ts = ex
+                    line += f' # {{trace_id="{tid}"}} {value} {ts}'
+                    ex = None
+                out.append(line)
+            line = f'chanamq_{name}_bucket{{le="+Inf"}} {hist.count}'
+            if ex is not None:
+                tid, value, ts = ex
+                line += f' # {{trace_id="{tid}"}} {value} {ts}'
+            out.append(line)
             out.append(f"chanamq_{name}_sum {hist.total_us}")
             out.append(f"chanamq_{name}_count {hist.count}")
         prof = getattr(self.broker, "profile", None)
@@ -1021,6 +1125,8 @@ class AdminServer:
                         out.append(
                             f"chanamq_forecast_error_last"
                             f'{{feature="{self._prom_label(name)}"}} {value}')
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n"
 
     def _overview(self) -> dict:

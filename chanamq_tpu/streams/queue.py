@@ -28,6 +28,7 @@ import datetime as _dt
 import logging
 from typing import TYPE_CHECKING, Any, Optional, Union
 
+from .. import trace
 from ..amqp.properties import BasicProperties
 from ..amqp.value_codec import Timestamp
 from ..broker.entities import Delivery, Message, Queue, QueuedMessage, now_ms
@@ -107,6 +108,12 @@ class StreamQueue(Queue):
     """Append-only segmented log queue (``x-queue-type: stream``)."""
 
     is_stream = True
+    # {record offset: Trace} for federated records that arrived with a
+    # W3C context (ISSUE 20): the federation apply populates it, the
+    # first materialization of that record consumes it, so mirror-side
+    # deliver/settle spans join the producer's trace. Class-level None
+    # keeps the untraced dispatch path at one falsy attribute check.
+    fed_traces: "dict | None" = None
 
     def __init__(
         self,
@@ -366,6 +373,10 @@ class StreamQueue(Queue):
         msg = Message(0, props, rec.body, rec.exchange, rec.routing_key,
                       header_raw=rec.header_raw)
         msg.refer_count = 1
+        if self.fed_traces:
+            tr = self.fed_traces.pop(rec.offset, None)
+            if tr is not None:
+                msg.trace = tr
         return msg
 
     # -- dispatch ----------------------------------------------------------
@@ -486,6 +497,12 @@ class StreamQueue(Queue):
             group.settle(delivery.queued.offset)
         else:
             self._commit(name, delivery.queued.offset)
+        if trace.ACTIVE is not None:
+            # a federated record's lifted trace (fed_traces) finishes at
+            # the consumer's settle, same as a classic queue's ack path
+            tr = delivery.queued.message.trace
+            if tr is not None:
+                trace.ACTIVE.on_settle(tr, self.broker.trace_node)
         self.broker.unrefer(delivery.queued.message)
 
     def drop(self, delivery: Delivery) -> None:  # type: ignore[override]

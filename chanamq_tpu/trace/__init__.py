@@ -46,6 +46,18 @@ def current_trace_id() -> Optional[str]:
     return cur.trace_id if cur is not None else None
 
 
+def current_w3c_trace_id() -> Optional[str]:
+    """The propagated W3C trace id of the current publish, if any — the
+    join key structured logs share with exported spans and exemplars."""
+    rt = ACTIVE
+    if rt is None:
+        return None
+    cur = rt.current
+    if cur is None or cur.w3c is None:
+        return None
+    return cur.w3c.trace_id
+
+
 def enable_from_config(config, broker) -> Optional[TraceRuntime]:
     """Install tracing per the ``chana.mq.trace.*`` block.
 

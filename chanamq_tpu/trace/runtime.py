@@ -25,6 +25,8 @@ import time
 from collections import OrderedDict, deque
 from typing import Iterable, Optional, Sequence
 
+from ..otel.context import W3CContext, derive_span_id
+from ..otel.context import extract as _w3c_extract
 from ..utils.metrics import Histogram, Metrics
 
 # Fixed pipeline stages, one slot each.  Order is pipeline order; the
@@ -68,7 +70,7 @@ TRAILER_MAGIC = 0x54524330  # "0CRT" on the wire, read back as TRC0
 
 class Trace:
     __slots__ = ("trace_id", "origin", "slots", "chaos_rules", "finished",
-                 "pending_ns")
+                 "pending_ns", "w3c", "attrs")
 
     def __init__(self, trace_id: str, origin: str) -> None:
         self.trace_id = trace_id
@@ -78,6 +80,20 @@ class Trace:
         self.finished = False
         # scratch timestamp used by the data plane between submit and flush
         self.pending_ns = 0
+        # propagated W3C context (otel.context.W3CContext) — None unless
+        # the publish carried a valid traceparent header
+        self.w3c = None
+        # routing attributes (exchange/queue/vhost/tenant), stamped at
+        # enqueue time for sampled messages only; drives /admin/traces
+        # filtering and the OTLP resource/span attributes
+        self.attrs: "dict | None" = None
+
+    def attr(self, key: str, value) -> None:
+        a = self.attrs
+        if a is None:
+            a = self.attrs = {}
+        if key not in a:
+            a[key] = value
 
     def span(self, stage: int, start_ns: int, end_ns: int, node: str) -> None:
         self.slots[stage] = (start_ns, end_ns, node)
@@ -93,6 +109,11 @@ class Trace:
                 self.slots[i] = s
         for rule in other.chaos_rules:
             self.tag_chaos(rule)
+        if self.w3c is None:
+            self.w3c = other.w3c
+        if other.attrs:
+            for key, value in other.attrs.items():
+                self.attr(key, value)
 
     @property
     def span_count(self) -> int:
@@ -121,7 +142,7 @@ class Trace:
                 "dur_us": round((end_ns - start_ns) / 1000.0, 1),
                 "node": node,
             }
-        return {
+        out = {
             "id": self.trace_id,
             "origin": self.origin,
             "total_us": round(self.total_us, 1),
@@ -130,11 +151,20 @@ class Trace:
             "chaos_rules": list(self.chaos_rules),
             "stages": stages,
         }
+        if self.w3c is not None:
+            out["w3c"] = self.w3c.to_dict()
+        if self.attrs:
+            out["attrs"] = dict(self.attrs)
+        return out
 
     # -- wire blob: u8 ver | ss id | ss origin | u8 nrules | ss rule* |
     #    u8 nspans | (u8 stage | u64 t0 | u64 t1 | ss node)*
+    #    v2 appends: u8 has_w3c | [ss tid | ss parent | ss root |
+    #    u8 flags | ss tracestate] | u8 nattrs | (ss key | ss value)*
+    #    Old decoders read exactly the v1 fields and ignore the tail, so
+    #    v2 is forward-compatible inside a mixed-version cluster.
     def to_blob(self) -> bytes:
-        parts = [b"\x01"]
+        parts = [b"\x02"]
         for text in (self.trace_id, self.origin):
             enc = text.encode("utf-8")[:255]
             parts.append(bytes((len(enc),)))
@@ -154,31 +184,63 @@ class Trace:
             parts.append(_U64.pack(t1))
             parts.append(bytes((len(enc),)))
             parts.append(enc)
+        w3c = self.w3c
+        if w3c is None:
+            parts.append(b"\x00")
+        else:
+            parts.append(b"\x01")
+            for text in (w3c.trace_id, w3c.parent_span_id,
+                         w3c.root_span_id, w3c.tracestate or ""):
+                enc = text.encode("utf-8")[:255]
+                parts.append(bytes((len(enc),)))
+                parts.append(enc)
+            parts.append(bytes((w3c.flags & 0xFF,)))
+        attrs = list((self.attrs or {}).items())[:255]
+        parts.append(bytes((len(attrs),)))
+        for key, value in attrs:
+            for text in (key, str(value)):
+                enc = text.encode("utf-8")[:255]
+                parts.append(bytes((len(enc),)))
+                parts.append(enc)
         return b"".join(parts)
 
     @classmethod
     def from_blob(cls, blob) -> "Trace":
         view = memoryview(blob)
-        pos = 1  # version byte; v1 only
-        texts = []
-        for _ in range(2):
+        version = view[0]
+        pos = 1
+
+        def ss():
+            nonlocal pos
             n = view[pos]; pos += 1
-            texts.append(bytes(view[pos:pos + n]).decode("utf-8")); pos += n
-        tr = cls(texts[0], texts[1])
+            text = bytes(view[pos:pos + n]).decode("utf-8"); pos += n
+            return text
+
+        tr = cls(ss(), ss())
         nrules = view[pos]; pos += 1
         for _ in range(nrules):
-            n = view[pos]; pos += 1
-            tr.chaos_rules.append(
-                bytes(view[pos:pos + n]).decode("utf-8")); pos += n
+            tr.chaos_rules.append(ss())
         nspans = view[pos]; pos += 1
         for _ in range(nspans):
             stage = view[pos]; pos += 1
             t0 = _U64.unpack_from(view, pos)[0]; pos += 8
             t1 = _U64.unpack_from(view, pos)[0]; pos += 8
-            n = view[pos]; pos += 1
-            node = bytes(view[pos:pos + n]).decode("utf-8"); pos += n
+            node = ss()
             if stage < len(STAGES):
                 tr.slots[stage] = (t0, t1, node)
+        if version >= 2 and pos < len(view):
+            if view[pos]:  # has_w3c flag (the byte itself consumed below)
+                pos += 1
+                tid, parent, root, state = ss(), ss(), ss(), ss()
+                flags = view[pos]; pos += 1
+                tr.w3c = W3CContext(tid, parent, root, flags=flags,
+                                    tracestate=state or None)
+            else:
+                pos += 1
+            nattrs = view[pos]; pos += 1
+            for _ in range(nattrs):
+                key = ss()
+                tr.attr(key, ss())
         return tr
 
 
@@ -246,6 +308,13 @@ class TraceRuntime:
         self.seed = seed
         self._rng = random.Random(seed)
         self._seq = 0
+        # forced (W3C-propagated) samples number their own sequence and
+        # never touch _rng/_seq: a headerless run stays draw-for-draw and
+        # id-for-id identical whether or not this path exists
+        self._wseq = 0
+        # set by the OTLP exporter: called with each trace finish() lands
+        # in the ring, off the per-message hot path
+        self.export_hook = None
         # trace attached to the publish currently being processed; only
         # set/cleared around synchronous sections (never held across await)
         self.current: Optional[Trace] = None
@@ -269,18 +338,79 @@ class TraceRuntime:
     def sample(self) -> bool:
         return self._rng.random() < self.rate
 
-    def begin_publish(self, node: Optional[str] = None) -> Optional[Trace]:
+    def begin_publish(self, node: Optional[str] = None,
+                      headers: Optional[dict] = None) -> Optional[Trace]:
         """One uniform draw; mint + stamp ingress-parse when sampled.
 
         Always (re)sets ``current`` so a previous publish's trace can
         never leak onto the next message.
+
+        A valid ``traceparent`` in ``headers`` force-samples on a
+        SEPARATE path that skips the draw entirely: the seeded sampling
+        sequence (and the ``node#seq`` ids it mints) stays byte-identical
+        for every publish that does not carry a context, which is what
+        the same-seed soak determinism gates compare. A malformed header
+        falls through to the normal seeded path without breaking the
+        publish (W3C: restart the trace).
         """
+        if headers is not None:
+            ctx = _w3c_extract(headers)
+            if ctx is not None:
+                return self._begin_forced(node, ctx)
         if self._rng.random() >= self.rate:
             self.current = None
             return None
         node = node or self.node
         self._seq += 1
         tr = Trace(f"{node}#{self._seq}", node)
+        self._stamp_ingress(tr, node)
+        self.current = tr
+        if self.metrics is not None:
+            self.metrics.trace_sampled += 1
+        return tr
+
+    def _begin_forced(self, node: Optional[str], ctx: tuple) -> Trace:
+        """Mint a force-sampled trace for a propagated W3C context.
+
+        Ids are derived (otel.context), never drawn, and the forced
+        sequence counter is separate from the seeded one — see
+        begin_publish for why."""
+        node = node or self.node
+        tid, parent, flags, state = ctx
+        self._wseq += 1
+        tr = Trace(f"{node}#w{self._wseq}", node)
+        tr.w3c = W3CContext(
+            tid, parent,
+            derive_span_id(tid, parent, node, str(self._wseq)),
+            flags=flags | 0x01, tracestate=state)
+        self._stamp_ingress(tr, node)
+        self.current = tr
+        if self.metrics is not None:
+            self.metrics.trace_sampled += 1
+            self.metrics.otel_forced_samples += 1
+        return tr
+
+    def begin_remote(self, ctx: tuple, node: Optional[str] = None,
+                     attrs: Optional[dict] = None) -> Trace:
+        """Force-sampled trace for a context that arrived INSIDE shipped
+        data rather than on a live publish (federation segment apply):
+        no ingress window to stamp, the caller owns the stage spans."""
+        node = node or self.node
+        tid, parent, flags, state = ctx
+        self._wseq += 1
+        tr = Trace(f"{node}#w{self._wseq}", node)
+        tr.w3c = W3CContext(
+            tid, parent,
+            derive_span_id(tid, parent, node, str(self._wseq)),
+            flags=flags | 0x01, tracestate=state)
+        if attrs:
+            tr.attrs = dict(attrs)
+        if self.metrics is not None:
+            self.metrics.trace_sampled += 1
+            self.metrics.otel_forced_samples += 1
+        return tr
+
+    def _stamp_ingress(self, tr: Trace, node: str) -> None:
         now = time.perf_counter_ns()
         t0 = self.ingress_ns
         if not t0 or t0 > now or now - t0 > 50_000_000:
@@ -294,10 +424,6 @@ class TraceRuntime:
                 # same staleness bound as ingress: the span belongs to the
                 # publish stream released just now, not an old episode
                 tr.span(FLOW_THROTTLE, f0, f1, node)
-        self.current = tr
-        if self.metrics is not None:
-            self.metrics.trace_sampled += 1
-        return tr
 
     # -- cross-node bookkeeping -------------------------------------------
     def park(self, tr: Trace) -> None:
@@ -373,6 +499,13 @@ class TraceRuntime:
                     m.trace_slow += 1
                 if tr.chaos_rules:
                     m.trace_chaos_tagged += 1
+        hook = self.export_hook
+        if hook is not None:
+            try:
+                hook(tr)
+            except Exception:  # pragma: no cover - exporter bug
+                # span export must never break message completion
+                self.export_hook = None
 
     # -- inspection --------------------------------------------------------
     def find(self, trace_id: str) -> Optional[Trace]:
@@ -388,6 +521,43 @@ class TraceRuntime:
                         best = tr
         return best
 
+    def query(self, *, queue: Optional[str] = None,
+              exchange: Optional[str] = None, vhost: Optional[str] = None,
+              tenant: Optional[str] = None, stage: Optional[str] = None,
+              min_duration_us: float = 0, limit: int = 50) -> "list[Trace]":
+        """Filtered view over the completed rings (slow first, then
+        recent), newest first, deduped by id — the /admin/traces query
+        layer. ``queue`` matches any member of the comma-joined queue
+        attr (a fanout lands in several); ``stage`` requires the named
+        stage slot to be populated."""
+        stage_idx = STAGES.index(stage) if stage in STAGES else None
+        out: "list[Trace]" = []
+        seen: set = set()
+        for pool in (self.slow, self.ring):
+            for tr in reversed(pool):
+                if tr.trace_id in seen:
+                    continue
+                seen.add(tr.trace_id)
+                a = tr.attrs or {}
+                if exchange is not None and a.get("exchange") != exchange:
+                    continue
+                if vhost is not None and a.get("vhost") != vhost:
+                    continue
+                if tenant is not None and a.get("tenant") != tenant:
+                    continue
+                if queue is not None and \
+                        queue not in (a.get("queue") or "").split(","):
+                    continue
+                if stage is not None and (
+                        stage_idx is None or tr.slots[stage_idx] is None):
+                    continue
+                if min_duration_us and tr.total_us < min_duration_us:
+                    continue
+                out.append(tr)
+                if len(out) >= limit:
+                    return out
+        return out
+
     def status(self, limit: int = 20) -> dict:
         return {
             "node": self.node,
@@ -396,6 +566,7 @@ class TraceRuntime:
             "slow_ms": self.slow_ms,
             "seed": self.seed,
             "sampled": self._seq,
+            "forced": self._wseq,
             "completed_in_ring": len(self.ring),
             "inflight": len(self._inflight),
             "recent": [t.to_dict() for t in list(self.ring)[-limit:]],

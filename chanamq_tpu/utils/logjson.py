@@ -43,6 +43,11 @@ class JsonLogFormatter(logging.Formatter):
         tid = trace.current_trace_id()
         if tid is not None:
             out["trace"] = tid
+            # a propagated W3C context adds the cross-system join key —
+            # the same trace_id exported spans and exemplars carry
+            w3c = trace.current_w3c_trace_id()
+            if w3c is not None:
+                out["trace_id"] = w3c
         # structured payloads: callers attach machine-readable fields via
         # `log.warning(..., extra={"data": {...}})` (e.g. the profiler's
         # slow-callback captures ship duration + folded stack this way)

@@ -124,6 +124,18 @@ class Metrics:
         self.trace_ctx_recv = 0
         self.trace_evicted = 0
         self.trace_stage_us: "dict[str, Histogram]" = {}
+        # OTLP interop (chanamq_tpu/otel/): forced samples minted for
+        # client-supplied traceparent headers, spans exported (push and
+        # pull combined), OTLP/HTTP batches posted, failed posts, traces
+        # shed by the bounded exporter queue / overload ladder, and pull
+        # requests served on /admin/otel/spans. All zero unless a
+        # traceparent arrives or chana.mq.otel.enabled is set.
+        self.otel_forced_samples = 0
+        self.otel_spans_exported = 0
+        self.otel_batches_sent = 0
+        self.otel_export_errors = 0
+        self.otel_spans_shed = 0
+        self.otel_pull_served = 0
         # per-entity telemetry (chanamq_tpu/telemetry/): sampler progress,
         # ring-slot pressure, and alert-engine transitions. All zero unless
         # the telemetry service is running (chana.mq.telemetry.enabled).
@@ -387,6 +399,12 @@ class Metrics:
             "trace_ctx_sent": self.trace_ctx_sent,
             "trace_ctx_recv": self.trace_ctx_recv,
             "trace_evicted": self.trace_evicted,
+            "otel_forced_samples": self.otel_forced_samples,
+            "otel_spans_exported": self.otel_spans_exported,
+            "otel_batches_sent": self.otel_batches_sent,
+            "otel_export_errors": self.otel_export_errors,
+            "otel_spans_shed": self.otel_spans_shed,
+            "otel_pull_served": self.otel_pull_served,
             "telemetry_ticks": self.telemetry_ticks,
             "telemetry_saturated_ticks": self.telemetry_saturated_ticks,
             "telemetry_evicted_entities": self.telemetry_evicted_entities,

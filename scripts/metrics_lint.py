@@ -66,6 +66,34 @@ def documented(readme: str) -> "tuple[set[str], set[str]]":
     return exact, prefixes
 
 
+def exemplar_gaps() -> "tuple[list[str], list[str]]":
+    """(uncovered, contradictions): histogram families with neither
+    exemplar support nor an explicit exemption, and families listed as
+    BOTH supported and exempt. Exemplar support is declared on
+    AdminServer (`_EXEMPLAR_FAMILIES` by name, `_EXEMPLAR_PREFIXES` by
+    prefix); a family an operator can scrape but never join to a trace
+    must be a deliberate decision recorded in `_EXEMPLAR_EXEMPT`."""
+    from chanamq_tpu.rest.admin import AdminServer
+    from chanamq_tpu.trace.runtime import TraceRuntime
+    from chanamq_tpu.utils.metrics import Metrics
+
+    metrics = Metrics()
+    # installing a runtime registers the per-stage trace_*_us families,
+    # exactly as a tracing-enabled boot does
+    TraceRuntime(metrics=metrics)
+    covered = set(AdminServer._EXEMPLAR_FAMILIES)
+    exempt = set(AdminServer._EXEMPLAR_EXEMPT)
+    prefixes = tuple(AdminServer._EXEMPLAR_PREFIXES)
+    uncovered, contradictions = [], []
+    for name in sorted(metrics.histograms()):
+        has_support = name in covered or name.startswith(prefixes)
+        if has_support and name in exempt:
+            contradictions.append(name)
+        elif not has_support and name not in exempt:
+            uncovered.append(name)
+    return uncovered, contradictions
+
+
 def main() -> int:
     readme = (ROOT / "README.md").read_text()
     exact, prefixes = documented(readme)
@@ -79,7 +107,18 @@ def main() -> int:
         for name in missing:
             print(f"  {name}")
         return 1
-    print("metrics lint: every exported chanamq_* series is documented")
+    uncovered, contradictions = exemplar_gaps()
+    if uncovered or contradictions:
+        for name in uncovered:
+            print(f"metrics lint: histogram {name!r} has no exemplar "
+                  "support — add it to AdminServer._EXEMPLAR_FAMILIES "
+                  "(or _EXEMPLAR_EXEMPT with a reason)")
+        for name in contradictions:
+            print(f"metrics lint: histogram {name!r} is both exemplar-"
+                  "supported and exempt — pick one")
+        return 1
+    print("metrics lint: every exported chanamq_* series is documented; "
+          "every histogram family has exemplar support or an exemption")
     return 0
 
 

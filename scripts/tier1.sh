@@ -239,6 +239,23 @@ done
     exit 1
 }
 
+echo "tier1: otel overhead smoke (5 s x2: OTLP export vs tracing alone <= 2%)"
+# both variants run tracing at the default 1% sample rate; the delta
+# isolates the otel layer (header probe + finish-hook enqueue + flusher
+# against a dead collector). Same retry rationale as the other gates
+ok=""
+for attempt in 1 2 3; do
+    if BENCH_SECONDS=5 timeout -k 10 120 python bench.py --otel-overhead; then
+        ok=1
+        break
+    fi
+    echo "tier1: otel overhead attempt $attempt over budget, retrying" >&2
+done
+[ -n "$ok" ] || {
+    echo "tier1: otel overhead smoke FAILED (3 attempts) — OTLP export cost over budget" >&2
+    exit 1
+}
+
 echo "tier1: SLO overhead smoke (5 s x2: SLI sampler + burn-rate eval <= 2%)"
 # same retry rationale as the other overhead gates
 ok=""

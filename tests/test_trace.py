@@ -363,10 +363,14 @@ async def test_trace_overhead_under_two_percent():
     throughput on the saturated transient/autoAck spec."""
     import bench
 
-    base = bench.run_spec("transient_autoack_3p3c")
-    traced = bench.run_spec("transient_autoack_3p3c", extra_env={
-        "CHANAMQ_TRACE_ENABLED": "true",
-        "CHANAMQ_TRACE_SAMPLE_RATE": "0.01"})
+    # run_spec drives its load generator with asyncio.run, which cannot
+    # nest inside this (asyncio-marked) test's running loop — hop each
+    # run onto a worker thread so it gets a loop of its own
+    base = await asyncio.to_thread(bench.run_spec, "transient_autoack_3p3c")
+    traced = await asyncio.to_thread(
+        bench.run_spec, "transient_autoack_3p3c", extra_env={
+            "CHANAMQ_TRACE_ENABLED": "true",
+            "CHANAMQ_TRACE_SAMPLE_RATE": "0.01"})
     assert "error" not in base, base
     assert "error" not in traced, traced
     assert traced["delivered_per_s"] >= base["delivered_per_s"] * 0.98, (
